@@ -1,0 +1,43 @@
+"""tensorframes_tpu — a TPU-native dataframe <-> tensor-program framework.
+
+Brand-new implementation of the capability surface of yupbank/tensorframes
+(TensorFrames: TensorFlow on Spark DataFrames) re-designed for TPU: the six
+verbs ``map_rows / map_blocks / map_blocks_trimmed / reduce_rows /
+reduce_blocks / aggregate`` plus the ``analyze`` shape-inference pass
+(reference contract: ``/root/reference/src/main/scala/org/tensorframes/Operations.scala:20-135``),
+executed as XLA computations via JAX (jit / shard_map over a device mesh)
+instead of per-Spark-partition libtensorflow JNI sessions.
+
+The user-facing module mirrors the reference's python API
+(``/root/reference/src/main/python/tensorframes/core.py:10-11``)::
+
+    import tensorframes_tpu as tfs
+
+    tf = tfs.TensorFrame.from_arrays({"x": np.arange(10.0)}, num_blocks=4)
+    out = tfs.map_blocks(lambda x: {"z": x + 3.0}, tf)
+    s = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, tf)
+"""
+
+from .analyze import analyze, explain, print_schema
+from .dtypes import ScalarType, by_name as scalar_type, supported_types
+from .frame import TensorFrame
+from .schema import ColumnInfo, Schema, SchemaError
+from .shape import Shape, ShapeError, UNKNOWN
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "analyze",
+    "explain",
+    "print_schema",
+    "ScalarType",
+    "scalar_type",
+    "supported_types",
+    "TensorFrame",
+    "ColumnInfo",
+    "Schema",
+    "SchemaError",
+    "Shape",
+    "ShapeError",
+    "UNKNOWN",
+]

@@ -1,0 +1,79 @@
+"""``analyze`` / ``print_schema`` — the shape-inference pass.
+
+Re-design of the reference's deep analysis
+(``/root/reference/src/main/scala/org/tensorframes/ExperimentalOperations.scala:35-157``):
+there, every element of every partition is visited recursively on the JVM
+(``analyzeData`` L119-131) and per-partition shapes are merged on the driver
+(L95-100) into column metadata.  Because a TensorFrame is already columnar,
+the same contract costs a vectorized pass over cell shapes instead of a
+per-element recursion:
+
+* uniform columns: the cell shape is read off the backing array in O(1);
+* ragged columns: shapes are merged across cells with the ``Shape.merge``
+  lattice (dims that disagree become Unknown — ``ExperimentalOperations.scala:147-157``);
+* the block (lead) dimension is the merged per-block row count: concrete when
+  every block has the same number of rows, Unknown otherwise
+  (``ExperimentalOperations.scala:85-92`` prepends the partition size).
+
+Result contract (consumed by all verb validation): block shape
+``[rows_or_unknown, d1, d2, ...]`` readable via ``frame.schema`` — the analog
+of ``ColumnInformation(field).stf``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from .frame import Column, TensorFrame
+from .schema import ColumnInfo, Schema
+from .shape import UNKNOWN, Shape
+
+
+def _merged_lead(frame: TensorFrame) -> int:
+    sizes = set(frame.block_sizes)
+    return sizes.pop() if len(sizes) == 1 else UNKNOWN
+
+
+def _analyze_column(col: Column, lead: int) -> ColumnInfo:
+    if not col.info.scalar_type.device_ok:
+        # host-only columns keep a rank-1 block shape: [rows]
+        return dataclasses.replace(col.info, block_shape=Shape((lead,)))
+    if not col.is_ragged:
+        cell = Shape(col.data.shape[1:])
+        return dataclasses.replace(col.info, block_shape=cell.prepend(lead))
+    cells = col.cells()
+    shapes = np.array([c.shape for c in cells], dtype=np.int64)
+    # vectorized lattice merge: a dim is concrete iff all cells agree on it
+    first = shapes[0]
+    agree = (shapes == first).all(axis=0)
+    merged = np.where(agree, first, UNKNOWN)
+    return dataclasses.replace(
+        col.info, block_shape=Shape(merged.tolist()).prepend(lead)
+    )
+
+
+def analyze(frame: TensorFrame) -> TensorFrame:
+    """Return the same frame with fully inferred tensor metadata.
+
+    Reference entry point: ``tfs.analyze(df)`` (``core.py:304-317`` ->
+    ``ExperimentalOperations.analyze`` L35-47).
+    """
+    lead = _merged_lead(frame)
+    infos: List[ColumnInfo] = [
+        _analyze_column(frame.column(n), lead) for n in frame.column_names
+    ]
+    return frame.with_schema(Schema(infos))
+
+
+def print_schema(frame: TensorFrame) -> None:
+    """Print the tensor schema (``tfs.print_schema``, ``core.py:293-302``)."""
+    print(explain(frame))
+
+
+def explain(frame: TensorFrame) -> str:
+    """Pretty-printed tensor schema (reference ``explain``,
+    ``DebugRowOps.scala:528-545`` / ``DataFrameInfo.scala:10-17``)."""
+    return frame.schema.explain()

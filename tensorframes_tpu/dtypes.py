@@ -1,0 +1,180 @@
+"""Scalar-type registry: the single source of truth for supported cell dtypes.
+
+TPU-native re-design of the reference's ``ScalarTypeOperation`` axis mapping
+(``/root/reference/src/main/scala/org/tensorframes/impl/datatypes.scala:27-324``):
+one record per supported scalar type, with lookups along every representation
+axis the framework touches.  The reference maps
+``SQL type <-> proto DataType <-> tf.DataType <-> JVM type``; here the axes are
+
+* numpy dtype (host columnar storage),
+* jax dtype (device compute; may differ from storage, e.g. f64 -> f32 when
+  ``jax_enable_x64`` is off, and the bf16 compute policy for TPU matmuls),
+* TF ``DataType`` proto enum value (for GraphDef import — see
+  ``tensorframes_tpu/graphdef``),
+* python scalar type (row-based construction).
+
+The reference supports Int/Long/Double/Float plus a partial Binary type
+(``datatypes.scala:328-622``).  We support those, plus bool and bf16 (TPU
+native).  Binary (bytes) columns are host-only passthrough: they can be carried
+through a frame and fed to host-side preprocessing, but never enter an XLA
+computation — the same restriction the reference documents for its Binary type
+(``datatypes.scala:571-622``: single-cell only, no tensor conversion).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+try:  # jax is a hard dependency of the framework, soft here for import order
+    import jax.numpy as jnp
+
+    _HAVE_JAX = True
+except Exception:  # pragma: no cover
+    jnp = None
+    _HAVE_JAX = False
+
+
+class DTypeError(TypeError):
+    """Raised for unsupported or inconsistent scalar types."""
+
+
+# TF DataType enum values (types.proto) — needed for GraphDef import/export.
+# These integer values are fixed by the public TensorFlow wire format.
+TF_FLOAT = 1
+TF_DOUBLE = 2
+TF_INT32 = 3
+TF_UINT8 = 4
+TF_STRING = 7
+TF_INT64 = 9
+TF_BOOL = 10
+TF_BFLOAT16 = 14
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarType:
+    """One supported cell scalar type with all its representations."""
+
+    name: str
+    np_dtype: np.dtype
+    tf_enum: int
+    py_type: Optional[type]
+    device_ok: bool = True  # False => host-only (binary)
+
+    @property
+    def jax_dtype(self):
+        if not self.device_ok:
+            raise DTypeError(f"scalar type {self.name} is host-only (no device dtype)")
+        return self.np_dtype
+
+    def __repr__(self):
+        return self.name
+
+
+float32 = ScalarType("float32", np.dtype(np.float32), TF_FLOAT, None)
+float64 = ScalarType("float64", np.dtype(np.float64), TF_DOUBLE, float)
+int32 = ScalarType("int32", np.dtype(np.int32), TF_INT32, None)
+int64 = ScalarType("int64", np.dtype(np.int64), TF_INT64, int)
+bool_ = ScalarType("bool", np.dtype(np.bool_), TF_BOOL, bool)
+bfloat16 = (
+    ScalarType("bfloat16", np.dtype(jnp.bfloat16), TF_BFLOAT16, None)
+    if _HAVE_JAX
+    else None
+)
+binary = ScalarType("binary", np.dtype(object), TF_STRING, bytes, device_ok=False)
+
+_ALL = [t for t in (float32, float64, int32, int64, bool_, bfloat16, binary) if t]
+
+_BY_NAME: Dict[str, ScalarType] = {t.name: t for t in _ALL}
+_BY_NP: Dict[np.dtype, ScalarType] = {t.np_dtype: t for t in _ALL if t.device_ok}
+_BY_TF_ENUM: Dict[int, ScalarType] = {t.tf_enum: t for t in _ALL}
+# python scalars: reference maps python float -> Double, int -> Long
+# (core.py's Spark convention); we keep that so row-built frames round-trip.
+_BY_PY: Dict[type, ScalarType] = {
+    float: float64,
+    int: int64,
+    bool: bool_,
+    bytes: binary,
+}
+
+
+def supported_types():
+    """All registered scalar types (reference ``SupportedOperations.ops``,
+    ``datatypes.scala:265-273``)."""
+    return list(_ALL)
+
+
+def by_name(name: str) -> ScalarType:
+    st = _BY_NAME.get(str(name))
+    if st is None:
+        raise DTypeError(
+            f"unsupported scalar type {name!r}; supported: {sorted(_BY_NAME)}"
+        )
+    return st
+
+
+def from_numpy(dtype) -> ScalarType:
+    """Lookup by numpy dtype (reference ``getOps`` by SQL type,
+    ``datatypes.scala:275-281``)."""
+    dt = np.dtype(dtype)
+    if dt == np.dtype(object):
+        return binary
+    st = _BY_NP.get(dt)
+    if st is None:
+        # canonicalise common aliases rather than failing outright
+        if dt.kind == "f" and dt.itemsize == 2 and "bfloat16" in _BY_NAME:
+            return _BY_NAME["bfloat16"]
+        if dt.kind == "i":
+            return int64 if dt.itemsize > 4 else int32
+        if dt.kind == "u":
+            return int64 if dt.itemsize >= 4 else int32
+        raise DTypeError(f"unsupported numpy dtype {dt!r}")
+    return st
+
+
+def from_tf_enum(enum: int) -> ScalarType:
+    """Lookup by TF ``DataType`` proto value (GraphDef import path)."""
+    st = _BY_TF_ENUM.get(int(enum))
+    if st is None:
+        raise DTypeError(f"unsupported TF DataType enum {enum}")
+    return st
+
+
+def from_python_value(v: Any) -> ScalarType:
+    """Infer the scalar type of one python cell value (reference
+    ``analyzeData``, ``ExperimentalOperations.scala:119-131``)."""
+    if isinstance(v, (np.generic, np.ndarray)):
+        return from_numpy(v.dtype)
+    for py, st in _BY_PY.items():
+        # bool must be checked before int (bool is a subclass of int)
+        if type(v) is py:
+            return st
+    if isinstance(v, str):
+        return binary
+    if isinstance(v, (list, tuple)):
+        if not v:
+            raise DTypeError("cannot infer scalar type of an empty sequence")
+        return from_python_value(v[0])
+    raise DTypeError(f"unsupported python value type {type(v).__name__}")
+
+
+def coerce(st: ScalarType, allow_x64: Optional[bool] = None) -> ScalarType:
+    """Map a storage type to the type that will actually run on device.
+
+    When jax runs without ``jax_enable_x64`` (the TPU default), float64/int64
+    computations are demoted; we make that demotion explicit and visible in the
+    schema instead of letting jax warn at trace time.
+    """
+    if allow_x64 is None and _HAVE_JAX:
+        import jax
+
+        allow_x64 = bool(jax.config.read("jax_enable_x64"))
+    if allow_x64:
+        return st
+    if st is float64:
+        return float32
+    if st is int64:
+        return int32
+    return st

@@ -1,0 +1,335 @@
+"""TensorFrame: the partitioned, tensor-schema'd columnar table.
+
+The reference operates on Spark DataFrames, whose physical unit of work is the
+partition: every verb materialises a partition to ``Array[Row]`` and feeds it to
+the tensor runtime as one batched block (``DebugRowOps.scala:377-391``,
+``TFDataOps.scala:27-59``).  The TPU-native equivalent drops the JVM row
+plumbing entirely: a ``TensorFrame`` stores each column as contiguous numpy
+memory (or a ragged list of cells pre-``analyze``), partitioned into *blocks*
+along the row axis.  Blocks are the sharding unit — on a device mesh each block
+maps to a mesh slot (SURVEY.md §2.7 P1/P2) — and columnar-contiguous storage
+makes host->HBM transfer a single zero-copy ``device_put`` instead of the
+reference's per-row ``TensorConverter`` appends (``datatypes.scala:93-127``).
+
+Construction mirrors the user surfaces the reference supports: rows of python
+scalars/lists (Spark ``createDataFrame`` style), column arrays, and pandas.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import dtypes
+from .dtypes import ScalarType
+from .schema import ColumnInfo, Schema, SchemaError
+from .shape import UNKNOWN, Shape
+
+
+def _is_ragged(cells: Sequence[np.ndarray]) -> bool:
+    if not cells:
+        return False
+    s0 = cells[0].shape
+    return any(c.shape != s0 for c in cells)
+
+
+@dataclasses.dataclass
+class Column:
+    """One column's physical storage.
+
+    ``data`` is either one ndarray of shape ``(num_rows, *cell)`` (uniform) or a
+    list of per-row cell ndarrays (ragged — cells disagree on shape).  Ragged
+    columns correspond to the reference's un-analyzed variable-size cells
+    (``TFDataOps.scala:86-103``); they must pass through ``analyze``/bucketing
+    before they can reach a compiled program.
+    """
+
+    info: ColumnInfo
+    data: Any  # np.ndarray | List[np.ndarray]
+
+    @property
+    def is_ragged(self) -> bool:
+        return not isinstance(self.data, np.ndarray) or self.data.dtype == object
+
+    def num_rows(self) -> int:
+        return len(self.data)
+
+    def cells(self) -> List[np.ndarray]:
+        if isinstance(self.data, np.ndarray) and self.data.dtype != object:
+            return list(self.data)
+        return list(self.data)
+
+    def slice(self, start: int, stop: int) -> Any:
+        return self.data[start:stop]
+
+
+def _column_from_cells(
+    name: str, cells: List[Any], st: Optional[ScalarType] = None
+) -> Column:
+    """Build a column from per-row python/numpy cells, inferring dtype and as
+    much shape as possible (the role of ``ColumnInformation.getDF`` fallback
+    inference, ``ColumnInformation.scala:94-138``)."""
+    if not cells:
+        raise SchemaError(f"column {name!r}: cannot build from zero rows")
+    if st is None:
+        st = dtypes.from_python_value(cells[0])
+    if not st.device_ok:
+        # host-only (binary/string) passthrough column
+        arr = np.empty(len(cells), dtype=object)
+        for i, c in enumerate(cells):
+            arr[i] = c
+        info = ColumnInfo(name, st, Shape((UNKNOWN,)))
+        return Column(info, arr)
+    np_cells = [np.asarray(c, dtype=st.np_dtype) for c in cells]
+    rank = np_cells[0].ndim
+    for i, c in enumerate(np_cells):
+        if c.ndim != rank:
+            raise SchemaError(
+                f"column {name!r}: row {i} has cell rank {c.ndim}, "
+                f"expected {rank} (mixed ranks are not supported)"
+            )
+    if _is_ragged(np_cells):
+        cell_shape = Shape((UNKNOWN,) * rank)
+        info = ColumnInfo(name, st, cell_shape.prepend(UNKNOWN))
+        return Column(info, np_cells)
+    data = np.stack(np_cells) if rank else np.asarray(np_cells, dtype=st.np_dtype)
+    info = ColumnInfo(name, st, Shape(data.shape).with_lead(UNKNOWN))
+    return Column(info, data)
+
+
+class TensorFrame:
+    """Partitioned columnar table with tensor schema.
+
+    Invariants: all columns have the same number of rows; partition offsets
+    cover ``[0, num_rows]``; ``schema`` is the single source of shape/dtype
+    truth (never derived lazily from Spark metadata as in the reference).
+    """
+
+    def __init__(
+        self,
+        columns: Sequence[Column],
+        offsets: Optional[Sequence[int]] = None,
+    ):
+        if not columns:
+            raise SchemaError("a TensorFrame needs at least one column")
+        n = columns[0].num_rows()
+        for c in columns:
+            if c.num_rows() != n:
+                raise SchemaError(
+                    f"column {c.info.name!r} has {c.num_rows()} rows, "
+                    f"expected {n}"
+                )
+        self._columns: Tuple[Column, ...] = tuple(columns)
+        self._by_name = {c.info.name: c for c in self._columns}
+        if len(self._by_name) != len(self._columns):
+            raise SchemaError("duplicate column names")
+        if offsets is None:
+            offsets = (0, n)
+        offsets = tuple(int(o) for o in offsets)
+        if offsets[0] != 0 or offsets[-1] != n or list(offsets) != sorted(offsets):
+            raise SchemaError(f"bad partition offsets {offsets} for {n} rows")
+        self._offsets = offsets
+
+    # -- constructors --------------------------------------------------------
+
+    @staticmethod
+    def from_rows(
+        rows: Sequence[Mapping[str, Any]],
+        schema: Optional[Schema] = None,
+        num_blocks: int = 1,
+    ) -> "TensorFrame":
+        """Build from row dicts (the Spark ``createDataFrame(data, schema)``
+        entry path used throughout the reference tests)."""
+        if not rows:
+            raise SchemaError("cannot build a TensorFrame from zero rows")
+        names = schema.names if schema else list(rows[0].keys())
+        cols = []
+        for name in names:
+            cells = [r[name] for r in rows]
+            st = schema[name].scalar_type if schema else None
+            col = _column_from_cells(name, cells, st)
+            if schema is not None:
+                declared = schema[name]
+                # data-derived shape must refine any concrete user declaration
+                if declared.block_shape.is_static:
+                    col.info.block_shape.check_more_precise_than(
+                        declared.block_shape, f"column {name!r}"
+                    )
+            cols.append(col)
+        return TensorFrame(cols).repartition(num_blocks)
+
+    @staticmethod
+    def from_arrays(
+        data: Mapping[str, Any], num_blocks: int = 1
+    ) -> "TensorFrame":
+        """Build from column name -> array (lead dim = rows)."""
+        cols = []
+        for name, arr in data.items():
+            if isinstance(arr, (list, tuple)) and arr and isinstance(
+                arr[0], np.ndarray
+            ):
+                cols.append(_column_from_cells(name, list(arr)))
+                continue
+            a = np.asarray(arr)
+            if a.dtype == object or a.dtype.kind in "US":
+                cols.append(_column_from_cells(name, list(a)))
+                continue
+            st = dtypes.from_numpy(a.dtype)
+            a = a.astype(st.np_dtype, copy=False)
+            info = ColumnInfo(name, st, Shape(a.shape).with_lead(UNKNOWN))
+            cols.append(Column(info, a))
+        return TensorFrame(cols).repartition(num_blocks)
+
+    @staticmethod
+    def from_pandas(df, num_blocks: int = 1) -> "TensorFrame":
+        data = {}
+        for name in df.columns:
+            s = df[name]
+            if s.dtype == object:
+                data[name] = list(s)
+            else:
+                data[name] = s.to_numpy()
+        return TensorFrame.from_arrays(data, num_blocks=num_blocks)
+
+    @staticmethod
+    def from_blocks(
+        blocks: Sequence[Mapping[str, np.ndarray]],
+        schema: Optional[Schema] = None,
+    ) -> "TensorFrame":
+        """Assemble from per-block column arrays (engine output path)."""
+        if not blocks:
+            raise SchemaError("no blocks")
+        names = schema.names if schema else list(blocks[0].keys())
+        offsets = [0]
+        for b in blocks:
+            offsets.append(offsets[-1] + len(next(iter(b.values()))))
+        cols = []
+        for name in names:
+            parts = [np.asarray(b[name]) for b in blocks]
+            ranks = {p.ndim for p in parts}
+            if len(ranks) != 1:
+                raise SchemaError(f"column {name!r}: blocks disagree on rank")
+            cell_shapes = {p.shape[1:] for p in parts}
+            if len(cell_shapes) == 1 and parts[0].dtype != object:
+                data = np.concatenate(parts) if len(parts) > 1 else parts[0]
+                st = dtypes.from_numpy(data.dtype)
+                info = ColumnInfo(name, st, Shape(data.shape).with_lead(UNKNOWN))
+                cols.append(Column(info, data))
+            else:
+                cells: List[np.ndarray] = []
+                for p in parts:
+                    cells.extend(list(p))
+                cols.append(_column_from_cells(name, cells))
+        return TensorFrame(cols, offsets)
+
+    # -- schema / metadata ---------------------------------------------------
+
+    @property
+    def schema(self) -> Schema:
+        return Schema(c.info for c in self._columns)
+
+    def with_schema(self, schema: Schema) -> "TensorFrame":
+        """Attach refined metadata (the ``analyze`` output path — reference
+        ``ExperimentalOperations.scala:40-46`` re-selects columns with new
+        metadata; here we just swap the infos)."""
+        if schema.names != [c.info.name for c in self._columns]:
+            raise SchemaError("with_schema: column names must match")
+        cols = [
+            Column(info, c.data) for info, c in zip(schema.columns, self._columns)
+        ]
+        return TensorFrame(cols, self._offsets)
+
+    # -- basic accessors -----------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        return self._columns[0].num_rows()
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._offsets) - 1
+
+    @property
+    def block_sizes(self) -> List[int]:
+        return [
+            self._offsets[i + 1] - self._offsets[i]
+            for i in range(self.num_blocks)
+        ]
+
+    @property
+    def column_names(self) -> List[str]:
+        return [c.info.name for c in self._columns]
+
+    def column(self, name: str) -> Column:
+        c = self._by_name.get(name)
+        if c is None:
+            raise SchemaError(
+                f"column {name!r} not found; available: {self.column_names}"
+            )
+        return c
+
+    # -- block iteration (the engine's input) --------------------------------
+
+    def block(self, i: int) -> Dict[str, Any]:
+        lo, hi = self._offsets[i], self._offsets[i + 1]
+        return {c.info.name: c.slice(lo, hi) for c in self._columns}
+
+    def blocks(self) -> Iterable[Dict[str, Any]]:
+        for i in range(self.num_blocks):
+            yield self.block(i)
+
+    # -- transformations -----------------------------------------------------
+
+    def repartition(self, num_blocks: int) -> "TensorFrame":
+        """Rebalance into ``num_blocks`` near-equal blocks (Spark
+        ``repartition`` analog; used to map blocks onto mesh slots)."""
+        n = self.num_rows
+        if num_blocks < 1:
+            raise SchemaError(f"num_blocks must be >= 1, got {num_blocks}")
+        num_blocks = min(num_blocks, n) or 1
+        base, extra = divmod(n, num_blocks)
+        offsets = [0]
+        for i in range(num_blocks):
+            offsets.append(offsets[-1] + base + (1 if i < extra else 0))
+        return TensorFrame(list(self._columns), offsets)
+
+    def select(self, names: Sequence[str]) -> "TensorFrame":
+        return TensorFrame([self.column(n) for n in names], self._offsets)
+
+    # -- materialisation -----------------------------------------------------
+
+    def collect(self) -> List[Dict[str, Any]]:
+        """All rows as dicts of python/numpy values (Spark ``collect``)."""
+        out = []
+        cells = {c.info.name: c.cells() for c in self._columns}
+        for i in range(self.num_rows):
+            out.append({name: cs[i] for name, cs in cells.items()})
+        return out
+
+    def to_arrays(self) -> Dict[str, Any]:
+        out = {}
+        for c in self._columns:
+            if c.is_ragged:
+                out[c.info.name] = c.cells()
+            else:
+                out[c.info.name] = c.data
+        return out
+
+    def to_pandas(self):
+        import pandas as pd
+
+        data = {}
+        for c in self._columns:
+            if c.is_ragged or c.info.cell_shape.rank > 0:
+                data[c.info.name] = c.cells()
+            else:
+                data[c.info.name] = c.data
+        return pd.DataFrame(data)
+
+    def __repr__(self):
+        return (
+            f"TensorFrame[{self.num_rows} rows x {len(self._columns)} cols, "
+            f"{self.num_blocks} block(s)]\n{self.schema.explain()}"
+        )

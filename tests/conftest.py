@@ -1,0 +1,32 @@
+"""Test fixture: force an 8-device virtual CPU mesh before jax initialises.
+
+The reference tests distribution via multi-partition local Spark
+(``local[1]`` + ``makeRDD(..., 2)`` — SURVEY.md §4); our analog is jax's
+virtual CPU devices, so every multi-device code path (shard_map, psum,
+collectives) runs in CI without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The reference computes in float64 by default (python floats -> Double,
+# datatypes.scala:328-387).  Enable x64 on the CPU test mesh so dtype-fidelity
+# tests exercise the full registry; TPU runs use f32/bf16 regardless.
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,48 @@
+"""Scalar-type registry tests — the datatypes.scala axis-mapping contract."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu import dtypes
+
+
+def test_registry_roundtrip():
+    for st in dtypes.supported_types():
+        assert dtypes.by_name(st.name) is st
+        assert dtypes.from_tf_enum(st.tf_enum) is st
+
+
+def test_numpy_lookup():
+    assert dtypes.from_numpy(np.float32) is dtypes.float32
+    assert dtypes.from_numpy(np.float64) is dtypes.float64
+    assert dtypes.from_numpy(np.int32) is dtypes.int32
+    assert dtypes.from_numpy(np.int64) is dtypes.int64
+    assert dtypes.from_numpy(np.bool_) is dtypes.bool_
+    assert dtypes.from_numpy(object) is dtypes.binary
+    # aliases canonicalise rather than fail
+    assert dtypes.from_numpy(np.int16) is dtypes.int32
+    with pytest.raises(dtypes.DTypeError):
+        dtypes.from_numpy(np.complex64)
+
+
+def test_python_value_inference():
+    # reference convention: python float -> double, int -> long (core.py)
+    assert dtypes.from_python_value(1.5) is dtypes.float64
+    assert dtypes.from_python_value(3) is dtypes.int64
+    assert dtypes.from_python_value(True) is dtypes.bool_
+    assert dtypes.from_python_value(b"xyz") is dtypes.binary
+    assert dtypes.from_python_value([1.0, 2.0]) is dtypes.float64
+    assert dtypes.from_python_value(np.float32(1)) is dtypes.float32
+
+
+def test_binary_is_host_only():
+    assert not dtypes.binary.device_ok
+    with pytest.raises(dtypes.DTypeError):
+        _ = dtypes.binary.jax_dtype
+
+
+def test_coerce_demotion():
+    assert dtypes.coerce(dtypes.float64, allow_x64=False) is dtypes.float32
+    assert dtypes.coerce(dtypes.int64, allow_x64=False) is dtypes.int32
+    assert dtypes.coerce(dtypes.float64, allow_x64=True) is dtypes.float64
+    assert dtypes.coerce(dtypes.float32, allow_x64=False) is dtypes.float32

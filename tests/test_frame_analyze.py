@@ -1,0 +1,102 @@
+"""TensorFrame construction + analyze() — mirrors ExtraOperationsSuite.scala."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.shape import UNKNOWN
+
+
+def test_from_arrays_scalar_col():
+    tf = tfs.TensorFrame.from_arrays({"x": np.arange(10.0)})
+    assert tf.num_rows == 10
+    assert tf.num_blocks == 1
+    ci = tf.schema["x"]
+    assert ci.scalar_type.name == "float64"
+    assert ci.cell_shape.rank == 0
+
+
+def test_from_rows_scalars():
+    # ExtraOperationsSuite: simple scalar analysis
+    tf = tfs.TensorFrame.from_rows([{"x": 1.0}, {"x": 2.0}, {"x": 3.0}])
+    tf = tfs.analyze(tf)
+    assert tf.schema["x"].block_shape == (3,)
+    assert tf.schema["x"].is_analyzed
+
+
+def test_from_rows_vectors_uniform():
+    tf = tfs.TensorFrame.from_rows(
+        [{"v": [1.0, 2.0]}, {"v": [3.0, 4.0]}]
+    )
+    tf = tfs.analyze(tf)
+    assert tf.schema["v"].block_shape == (2, 2)
+    assert tf.schema["v"].cell_shape == (2,)
+
+
+def test_ragged_merge_to_unknown():
+    # variable-size rows -> unknown inner dim (ExtraOperationsSuite.scala:84-98)
+    tf = tfs.TensorFrame.from_rows([{"v": [1.0, 2.0]}, {"v": [3.0]}])
+    tf = tfs.analyze(tf)
+    ci = tf.schema["v"]
+    assert ci.block_shape == (2, UNKNOWN)
+    assert not ci.is_analyzed
+
+
+def test_multiblock_lead_dim():
+    # equal blocks -> concrete lead; unequal -> unknown
+    tf = tfs.TensorFrame.from_arrays({"x": np.arange(8.0)}, num_blocks=4)
+    assert tfs.analyze(tf).schema["x"].block_shape == (2,)
+    tf2 = tfs.TensorFrame.from_arrays({"x": np.arange(7.0)}, num_blocks=3)
+    assert tfs.analyze(tf2).schema["x"].block_shape == (UNKNOWN,)
+
+
+def test_repartition_and_blocks():
+    tf = tfs.TensorFrame.from_arrays({"x": np.arange(10.0)}, num_blocks=3)
+    assert tf.block_sizes == [4, 3, 3]
+    blocks = list(tf.blocks())
+    assert [len(b["x"]) for b in blocks] == [4, 3, 3]
+    np.testing.assert_array_equal(
+        np.concatenate([b["x"] for b in blocks]), np.arange(10.0)
+    )
+
+
+def test_collect_roundtrip():
+    rows = [{"a": 1.0, "b": [1.0, 2.0]}, {"a": 2.0, "b": [3.0, 4.0]}]
+    tf = tfs.TensorFrame.from_rows(rows)
+    got = tf.collect()
+    assert [float(r["a"]) for r in got] == [1.0, 2.0]
+    np.testing.assert_array_equal(got[1]["b"], [3.0, 4.0])
+
+
+def test_binary_column_passthrough():
+    tf = tfs.TensorFrame.from_rows(
+        [{"k": b"ab", "x": 1.0}, {"k": b"cd", "x": 2.0}]
+    )
+    tf = tfs.analyze(tf)
+    assert tf.schema["k"].scalar_type.name == "binary"
+    assert tf.collect()[0]["k"] == b"ab"
+
+
+def test_pandas_roundtrip():
+    pd = pytest.importorskip("pandas")
+    df = pd.DataFrame({"x": [1.0, 2.0], "y": [3, 4]})
+    tf = tfs.TensorFrame.from_pandas(df, num_blocks=2)
+    back = tf.to_pandas()
+    assert list(back["x"]) == [1.0, 2.0]
+    assert list(back["y"]) == [3, 4]
+
+
+def test_explain_mentions_columns():
+    tf = tfs.analyze(tfs.TensorFrame.from_arrays({"x": np.arange(4.0)}))
+    s = tfs.explain(tf)
+    assert "x" in s and "float64" in s
+
+
+def test_schema_errors():
+    with pytest.raises(tfs.SchemaError):
+        tfs.TensorFrame.from_arrays({"x": np.arange(3.0), "y": np.arange(4.0)})
+    with pytest.raises(tfs.SchemaError):
+        tfs.TensorFrame.from_rows([])
+    tf = tfs.TensorFrame.from_arrays({"x": np.arange(3.0)})
+    with pytest.raises(tfs.SchemaError):
+        tf.column("nope")

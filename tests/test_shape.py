@@ -1,0 +1,68 @@
+"""Shape algebra tests — mirrors the contract of reference Shape.scala."""
+
+import pytest
+
+from tensorframes_tpu.shape import UNKNOWN, Shape, ShapeError
+
+
+def test_basic_accessors():
+    s = Shape((2, 3))
+    assert s.rank == 2
+    assert s.dims == (2, 3)
+    assert not s.is_scalar
+    assert s.is_static
+    assert s.num_elements() == 6
+    assert Shape(()).is_scalar
+
+
+def test_unknown_dims():
+    s = Shape((UNKNOWN, 3))
+    assert not s.is_static
+    assert s.num_elements() is None
+    assert repr(s) == "[?,3]"
+
+
+def test_prepend_tail():
+    cell = Shape((3,))
+    block = cell.prepend(10)
+    assert block == (10, 3)
+    assert block.tail() == cell
+    with pytest.raises(ShapeError):
+        Shape(()).tail()
+
+
+def test_with_lead():
+    assert Shape((UNKNOWN, 3)).with_lead(7) == (7, 3)
+
+
+def test_precision_lattice():
+    # checkMorePreciseThan semantics (Shape.scala:54-59)
+    assert Shape((2, 3)).is_more_precise_than(Shape((UNKNOWN, 3)))
+    assert Shape((2, 3)).is_more_precise_than(Shape((2, 3)))
+    assert not Shape((2, 3)).is_more_precise_than(Shape((2, 4)))
+    assert not Shape((2, 3)).is_more_precise_than(Shape((2,)))
+    with pytest.raises(ShapeError):
+        Shape((2, 3)).check_more_precise_than(Shape((5, 3)))
+
+
+def test_merge_lattice():
+    # ExperimentalOperations.scala:147-157 merge semantics
+    assert Shape((2, 3)).merge(Shape((2, 3))) == (2, 3)
+    assert Shape((2, 3)).merge(Shape((4, 3))) == (UNKNOWN, 3)
+    assert Shape((UNKNOWN, 3)).merge(Shape((2, 3))) == (UNKNOWN, 3)
+    with pytest.raises(ShapeError):
+        Shape((2,)).merge(Shape((2, 3)))
+
+
+def test_resolve():
+    s = Shape((UNKNOWN, 3))
+    assert s.resolve((5, 3)) == (5, 3)
+    with pytest.raises(ShapeError):
+        s.resolve((5, 4))
+    with pytest.raises(ShapeError):
+        s.resolve((5, UNKNOWN))
+
+
+def test_illegal_dims():
+    with pytest.raises(ShapeError):
+        Shape((-2,))

@@ -21,10 +21,28 @@ The user-facing module mirrors the reference's python API
 from .analyze import analyze, explain, print_schema
 from .dtypes import ScalarType, by_name as scalar_type, supported_types
 from .frame import TensorFrame
+from .ops import (
+    Executor,
+    ValidationError,
+    aggregate,
+    group_by,
+    map_blocks,
+    map_rows,
+    reduce_blocks,
+    reduce_rows,
+)
+from .program import GraphNodeSummary, Program, ProgramError
 from .schema import ColumnInfo, Schema, SchemaError
 from .shape import Shape, ShapeError, UNKNOWN
 
 __version__ = "0.1.0"
+
+
+def map_blocks_trimmed(fn, frame, **kw):
+    """``tfs.map_blocks(..., trim=True)`` — output row count may differ from
+    the input's (reference ``Operations.scala:61-80``)."""
+    return map_blocks(fn, frame, trim=True, **kw)
+
 
 __all__ = [
     "analyze",
@@ -40,4 +58,16 @@ __all__ = [
     "Shape",
     "ShapeError",
     "UNKNOWN",
+    "Executor",
+    "ValidationError",
+    "aggregate",
+    "group_by",
+    "map_blocks",
+    "map_blocks_trimmed",
+    "map_rows",
+    "reduce_blocks",
+    "reduce_rows",
+    "Program",
+    "ProgramError",
+    "GraphNodeSummary",
 ]

@@ -244,6 +244,14 @@ class TensorFrame:
     # -- basic accessors -----------------------------------------------------
 
     @property
+    def columns(self) -> Tuple[Column, ...]:
+        return self._columns
+
+    @property
+    def offsets(self) -> Tuple[int, ...]:
+        return self._offsets
+
+    @property
     def num_rows(self) -> int:
         return self._columns[0].num_rows()
 
@@ -297,6 +305,12 @@ class TensorFrame:
 
     def select(self, names: Sequence[str]) -> "TensorFrame":
         return TensorFrame([self.column(n) for n in names], self._offsets)
+
+    def group_by(self, *keys: str):
+        """Group rows by key columns for ``aggregate`` (Spark ``groupBy``)."""
+        from .ops.engine import GroupedFrame
+
+        return GroupedFrame(self, keys)
 
     # -- materialisation -----------------------------------------------------
 

@@ -1,0 +1,23 @@
+"""Execution engine package: verb validation + single-device executor."""
+
+from .engine import (
+    Executor,
+    aggregate,
+    group_by,
+    map_blocks,
+    map_rows,
+    reduce_blocks,
+    reduce_rows,
+)
+from .validation import ValidationError
+
+__all__ = [
+    "Executor",
+    "aggregate",
+    "group_by",
+    "map_blocks",
+    "map_rows",
+    "reduce_blocks",
+    "reduce_rows",
+    "ValidationError",
+]

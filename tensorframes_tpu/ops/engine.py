@@ -1,0 +1,498 @@
+"""The execution engine: the six verbs, single-device XLA edition.
+
+Re-design of the reference engine ``DebugRowOps``
+(``/root/reference/src/main/scala/org/tensorframes/impl/DebugRowOps.scala:281-970``).
+The mapping, per SURVEY.md §2.7:
+
+* per-partition TF sessions (P1) -> one jit-compiled XLA executable reused for
+  every block with the same signature (jax's jit cache *is* the program
+  broadcast, P6);
+* partition blocks (P2) -> contiguous columnar arrays, a single ``device_put``
+  each instead of per-row ``TensorConverter`` appends;
+* ``map_rows`` -> ``vmap`` of the cell-level program over the block's lead
+  axis (instead of one session.run per row, ``DebugRowOps.scala:819-857``);
+* ``reduce_rows``'s sequential pairwise fold (``performReducePairwise``,
+  ``DebugRowOps.scala:930-969``) -> a balanced binary tree of ``vmap``-ed
+  pairwise applications, traced with static sizes (deterministic; a
+  ``mode="sequential"`` ``lax.scan`` fold reproduces the reference's exact
+  left-fold order for non-associative programs);
+* ``reduce_blocks``'s two phases (``DebugRowOps.scala:503-526``) -> per-block
+  reduce, then ONE re-application of the same block program to the stacked
+  partials (the contract already requires the program to reduce any-size
+  blocks, so no pairwise driver loop is needed);
+* ``aggregate``'s shuffle + buffered UDAF (``DebugRowOps.scala:547-695``) ->
+  host group-index build + size-bucketed ``vmap`` of the block program over
+  all groups of equal cardinality (no buffer-size-10 compaction artifact).
+
+The ``Executor`` here is single-device; ``tensorframes_tpu.parallel`` provides
+the mesh/``shard_map`` executor with collective cross-shard reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes
+from ..frame import Column, TensorFrame
+from ..program import Program
+from ..schema import ColumnInfo, Schema
+from ..shape import Shape, UNKNOWN
+from . import validation
+from .validation import ValidationError
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(jax.device_get(x))
+
+
+class GroupedFrame:
+    """Result of ``group_by`` — the ``RelationalGroupedDataset`` analog."""
+
+    def __init__(self, frame: TensorFrame, keys: Sequence[str]):
+        if not keys:
+            raise ValidationError("group_by needs at least one key column")
+        for k in keys:
+            ci = frame.schema[k]
+            if ci.cell_shape.rank != 0:
+                raise ValidationError(
+                    f"group_by: key column {k!r} must be scalar, has cell "
+                    f"shape {ci.cell_shape}"
+                )
+        self.frame = frame
+        self.keys = list(keys)
+
+
+def group_by(frame: TensorFrame, *keys: str) -> GroupedFrame:
+    return GroupedFrame(frame, keys)
+
+
+class Executor:
+    """Single-device verb executor."""
+
+    # ---------------------------------------------------------------- map --
+
+    def _device_inputs(
+        self,
+        program: Program,
+        block: Mapping[str, Any],
+        infos: Mapping[str, ColumnInfo],
+    ) -> Dict[str, jnp.ndarray]:
+        inputs = {}
+        for n in program.input_names:
+            ci = infos[n]
+            st = dtypes.coerce(ci.scalar_type)
+            arr = np.asarray(block[program.column_for_input(n)])
+            if arr.dtype != st.np_dtype:
+                arr = arr.astype(st.np_dtype)
+            inputs[n] = jnp.asarray(arr)
+        return inputs
+
+    def _run_block_program(self, program: Program, inputs) -> Dict[str, Any]:
+        return program.jitted()(inputs)
+
+    def map_blocks(
+        self, program: Program, frame: TensorFrame, trim: bool = False
+    ) -> TensorFrame:
+        """``mapBlocks`` (``DebugRowOps.scala:290-393``) /
+        ``mapBlocksTrimmed`` (trim=True: output row count may differ, no
+        passthrough columns — ``Operations.scala:61-80``)."""
+        infos = validation.check_map_inputs(program, frame, "map_blocks")
+        out_blocks: List[Dict[str, np.ndarray]] = []
+        for bi in range(frame.num_blocks):
+            block = frame.block(bi)
+            n_rows = len(next(iter(block.values())))
+            inputs = self._device_inputs(program, block, infos)
+            outs = self._run_block_program(program, inputs)
+            host = {k: _np(v) for k, v in outs.items()}
+            if not trim:
+                for name, v in host.items():
+                    if v.ndim == 0 or v.shape[0] != n_rows:
+                        raise ValidationError(
+                            f"map_blocks: output {name!r} has shape "
+                            f"{v.shape} but the input block has {n_rows} "
+                            f"rows; a non-trimmed map must preserve the row "
+                            f"count (use map_blocks_trimmed to change it)."
+                        )
+            else:
+                counts = {v.shape[0] if v.ndim else None for v in host.values()}
+                if len(counts) != 1 or None in counts:
+                    raise ValidationError(
+                        f"map_blocks_trimmed: outputs disagree on row count: "
+                        f"{ {k: v.shape for k, v in host.items()} }"
+                    )
+            out_blocks.append(host)
+        return self._build_map_output(frame, program, out_blocks, trim)
+
+    def map_rows(
+        self, program: Program, frame: TensorFrame
+    ) -> TensorFrame:
+        """``mapRows`` (``DebugRowOps.scala:396-477``): the program is written
+        at *cell* level and vmapped over the block's rows."""
+        infos = validation.check_map_inputs(program, frame, "map_rows")
+        vmapped = jax.jit(jax.vmap(lambda ins: program.call(ins)))
+        out_blocks: List[Dict[str, np.ndarray]] = []
+        for bi in range(frame.num_blocks):
+            block = frame.block(bi)
+            inputs = self._device_inputs(program, block, infos)
+            outs = vmapped(inputs)
+            out_blocks.append({k: _np(v) for k, v in outs.items()})
+        return self._build_map_output(frame, program, out_blocks, trim=False)
+
+    def _build_map_output(
+        self,
+        frame: TensorFrame,
+        program: Program,
+        out_blocks: List[Dict[str, np.ndarray]],
+        trim: bool,
+    ) -> TensorFrame:
+        out_frame = TensorFrame.from_blocks(out_blocks)
+        if trim:
+            return out_frame
+        # non-trimmed: append original columns not shadowed by outputs
+        # (reference output schema: outputs ++ original, DebugRowOps.scala:
+        # 349-372).  Divergence, by design: Spark tolerates duplicate column
+        # names so the reference can emit both; our schema forbids duplicates,
+        # so an output *shadows* the same-named passthrough column.
+        shadowed = set(out_frame.column_names)
+        cols = list(out_frame.columns)
+        for cname in frame.column_names:
+            if cname not in shadowed:
+                cols.append(frame.column(cname))
+        return TensorFrame(cols, out_frame.offsets)
+
+    # ------------------------------------------------------------- reduce --
+
+    def _pair_call(self, program: Program, bases: Sequence[str]):
+        def pairfn(left: Dict[str, Any], right: Dict[str, Any]):
+            inputs = {}
+            for b in bases:
+                inputs[f"{b}_1"] = left[b]
+                inputs[f"{b}_2"] = right[b]
+            return program.call(inputs)
+
+        return pairfn
+
+    def _tree_fold(
+        self, pairfn, arrays: Dict[str, jnp.ndarray]
+    ) -> Dict[str, jnp.ndarray]:
+        """Balanced deterministic tree fold over the lead axis (static size)."""
+        vpair = jax.vmap(pairfn)
+
+        def fold(arrs: Dict[str, jnp.ndarray]):
+            n = next(iter(arrs.values())).shape[0]
+            if n == 0:
+                raise ValidationError("cannot pairwise-fold zero rows")
+            if n == 1:
+                return {k: v[0] for k, v in arrs.items()}
+            half = n // 2
+            left = {k: v[:half] for k, v in arrs.items()}
+            right = {k: v[half : 2 * half] for k, v in arrs.items()}
+            combined = vpair(left, right)
+            if n % 2:
+                combined = {
+                    k: jnp.concatenate([v, arrs[k][2 * half :]])
+                    for k, v in combined.items()
+                }
+            return fold(combined)
+
+        return fold(arrays)
+
+    def _seq_fold(
+        self, pairfn, arrays: Dict[str, jnp.ndarray]
+    ) -> Dict[str, jnp.ndarray]:
+        """Left fold in row order — bit-exact reproduction of the reference's
+        sequential pairwise reduction (``performReducePairwise``,
+        ``DebugRowOps.scala:930-969``)."""
+        init = {k: v[0] for k, v in arrays.items()}
+        rest = {k: v[1:] for k, v in arrays.items()}
+
+        def step(carry, row):
+            return pairfn(carry, row), None
+
+        out, _ = jax.lax.scan(step, init, rest)
+        return out
+
+    def reduce_rows(
+        self, program: Program, frame: TensorFrame, mode: str = "tree"
+    ) -> Dict[str, np.ndarray]:
+        """``reduceRows`` (``DebugRowOps.scala:479-501``): pairwise-fold all
+        rows of the named columns down to one row."""
+        if frame.num_rows == 0:
+            raise ValidationError(
+                "reduce_rows: cannot reduce an empty frame (no identity "
+                "element is available for an arbitrary pairwise program)"
+            )
+        reduced = validation.check_reduce_rows(program, frame)
+        bases = sorted(reduced)
+        summaries = program.analyze(
+            {
+                f"{b}_{i}": (
+                    dtypes.coerce(reduced[b].scalar_type),
+                    tuple(reduced[b].cell_shape),
+                )
+                for b in bases
+                for i in (1, 2)
+            }
+        )
+        validation.check_reduce_rows_outputs(reduced, summaries)
+        if mode not in ("tree", "sequential"):
+            raise ValidationError(
+                f"reduce_rows: unknown mode {mode!r}; use 'tree' or "
+                f"'sequential'"
+            )
+        pairfn = self._pair_call(program, bases)
+        fold = self._tree_fold if mode == "tree" else self._seq_fold
+
+        @jax.jit
+        def run(arrs):
+            return fold(pairfn, arrs)
+
+        partials: List[Dict[str, jnp.ndarray]] = []
+        for bi in range(frame.num_blocks):
+            if frame.block_sizes[bi] == 0:
+                continue  # empty-partition guard (DebugRowOps.scala:489-499)
+            block = frame.block(bi)
+            arrays = {}
+            for b in bases:
+                ci = reduced[b]
+                st = dtypes.coerce(ci.scalar_type)
+                arrays[b] = jnp.asarray(
+                    np.asarray(block[b]).astype(st.np_dtype, copy=False)
+                )
+            partials.append(run(arrays))
+        if len(partials) == 1:
+            final = partials[0]
+        else:
+            stacked = {
+                b: jnp.stack([p[b] for p in partials]) for b in bases
+            }
+            final = run(stacked)
+        return {b: _np(final[b]) for b in bases}
+
+    def reduce_blocks(
+        self, program: Program, frame: TensorFrame
+    ) -> Dict[str, np.ndarray]:
+        """``reduceBlocks`` (``DebugRowOps.scala:503-526``): phase 1 reduces
+        each block to one row with the user's block program; phase 2 re-applies
+        the same program once to the stacked per-block partials."""
+        if frame.num_rows == 0:
+            raise ValidationError(
+                "reduce_blocks: cannot reduce an empty frame (no identity "
+                "element is available for an arbitrary block program)"
+            )
+        reduced = validation.check_reduce_blocks(program, frame)
+        bases = sorted(reduced)
+        # analyze at an arbitrary static block size to validate the contract
+        probe = max(frame.block_sizes) or 1
+        summaries = program.analyze(
+            {
+                f"{b}_input": (
+                    dtypes.coerce(reduced[b].scalar_type),
+                    (probe,) + tuple(reduced[b].cell_shape),
+                )
+                for b in bases
+            }
+        )
+        validation.check_reduce_blocks_outputs(reduced, summaries)
+
+        def block_call(arrs: Dict[str, jnp.ndarray]):
+            return program.call({f"{b}_input": arrs[b] for b in bases})
+
+        run = jax.jit(block_call)
+        partials: List[Dict[str, jnp.ndarray]] = []
+        for bi in range(frame.num_blocks):
+            if frame.block_sizes[bi] == 0:
+                continue  # empty-partition guard (DebugRowOps.scala:512-522)
+            block = frame.block(bi)
+            arrays = {}
+            for b in bases:
+                ci = reduced[b]
+                st = dtypes.coerce(ci.scalar_type)
+                arrays[b] = jnp.asarray(
+                    np.asarray(block[b]).astype(st.np_dtype, copy=False)
+                )
+            partials.append(run(arrays))
+        if len(partials) == 1:
+            final = partials[0]
+        else:
+            stacked = {b: jnp.stack([p[b] for p in partials]) for b in bases}
+            final = run(stacked)
+        return {b: _np(final[b]) for b in bases}
+
+    # ---------------------------------------------------------- aggregate --
+
+    def aggregate(
+        self, program: Program, grouped: GroupedFrame
+    ) -> TensorFrame:
+        """``aggregate`` (``DebugRowOps.scala:547-592`` + ``TensorFlowUDAF``
+        L601-695): apply the x_input block program once per key group.
+
+        Groups are bucketed by cardinality and each bucket runs as ONE
+        ``vmap``-ed device call over all its groups — the TPU-shaped
+        replacement for Spark's shuffle + row-buffered UDAF."""
+        frame = grouped.frame
+        reduced = validation.check_reduce_blocks(program, frame, verb="aggregate")
+        bases = sorted(reduced)
+        for k in grouped.keys:
+            if k in reduced:
+                raise ValidationError(
+                    f"aggregate: column {k!r} is both a grouping key and a "
+                    f"reduced column"
+                )
+
+        # --- host-side group index build (the shuffle replacement) ---
+        key_cells = [np.asarray(frame.column(k).data) for k in grouped.keys]
+        n = frame.num_rows
+        if len(key_cells) == 1:
+            uniq, inverse = np.unique(key_cells[0], return_inverse=True)
+            uniq_cols = [uniq]
+        else:
+            stacked = np.rec.fromarrays(key_cells)
+            uniq, inverse = np.unique(stacked, return_inverse=True)
+            uniq_cols = [np.asarray(uniq[name]) for name in uniq.dtype.names]
+        num_groups = len(uniq_cols[0])
+        order = np.argsort(inverse, kind="stable")
+        counts = np.bincount(inverse, minlength=num_groups)
+        starts = np.zeros(num_groups, dtype=np.int64)
+        np.cumsum(counts[:-1], out=starts[1:])
+
+        # validate the block-reduction contract at the largest group size
+        # (same check reduce_blocks performs; a program that does not reduce
+        # its block to one cell must fail loudly, not mis-shape the output)
+        probe = int(counts.max())
+        summaries = program.analyze(
+            {
+                f"{b}_input": (
+                    dtypes.coerce(reduced[b].scalar_type),
+                    (probe,) + tuple(reduced[b].cell_shape),
+                )
+                for b in bases
+            }
+        )
+        validation.check_reduce_blocks_outputs(
+            reduced, summaries, verb="aggregate"
+        )
+
+        # --- data columns, reordered so groups are contiguous ---
+        data = {}
+        for b in bases:
+            ci = reduced[b]
+            st = dtypes.coerce(ci.scalar_type)
+            data[b] = np.asarray(frame.column(b).data).astype(
+                st.np_dtype, copy=False
+            )[order]
+
+        def block_call(arrs: Dict[str, jnp.ndarray]):
+            return program.call({f"{b}_input": arrs[b] for b in bases})
+
+        vrun = jax.jit(jax.vmap(block_call))
+
+        # --- size-bucketed vmap over groups ---
+        out_cells: Dict[str, List[Tuple[int, np.ndarray]]] = {b: [] for b in bases}
+        by_size: Dict[int, List[int]] = {}
+        for g in range(num_groups):
+            by_size.setdefault(int(counts[g]), []).append(g)
+        for size, gids in sorted(by_size.items()):
+            gather = np.empty((len(gids), size), dtype=np.int64)
+            for i, g in enumerate(gids):
+                gather[i] = np.arange(starts[g], starts[g] + size)
+            batch = {b: jnp.asarray(data[b][gather]) for b in bases}
+            outs = vrun(batch)  # dict base -> [num_gids, *cell]
+            for b in bases:
+                host = _np(outs[b])
+                for i, g in enumerate(gids):
+                    out_cells[b].append((g, host[i]))
+
+        # --- assemble one-block result: keys ++ outputs, one row per group ---
+        cols: List[Column] = []
+        for kname, kvals in zip(grouped.keys, uniq_cols):
+            st = dtypes.from_numpy(kvals.dtype)
+            info = ColumnInfo(kname, st, Shape(kvals.shape).with_lead(UNKNOWN))
+            cols.append(Column(info, kvals))
+        for b in bases:
+            cells = [c for _, c in sorted(out_cells[b], key=lambda t: t[0])]
+            arr = np.stack(cells)
+            st = dtypes.from_numpy(arr.dtype)
+            info = ColumnInfo(b, st, Shape(arr.shape).with_lead(UNKNOWN))
+            cols.append(Column(info, arr))
+        return TensorFrame(cols)
+
+
+_DEFAULT = Executor()
+
+
+def _resolve(engine: Optional[Executor]) -> Executor:
+    return engine if engine is not None else _DEFAULT
+
+
+# ---------------------------------------------------------------------------
+# public verb API (the tfs.* surface, core.py:10-11)
+# ---------------------------------------------------------------------------
+
+
+def map_blocks(
+    fn,
+    frame: TensorFrame,
+    trim: bool = False,
+    fetches: Optional[Sequence[str]] = None,
+    feed_dict: Optional[Mapping[str, str]] = None,
+    engine: Optional[Executor] = None,
+) -> TensorFrame:
+    """Apply a block-level program to every block (``tfs.map_blocks``,
+    reference ``core.py:213-253``)."""
+    program = Program.wrap(fn, fetches, feed_dict)
+    return _resolve(engine).map_blocks(program, frame, trim=trim)
+
+
+def map_rows(
+    fn,
+    frame: TensorFrame,
+    fetches: Optional[Sequence[str]] = None,
+    feed_dict: Optional[Mapping[str, str]] = None,
+    engine: Optional[Executor] = None,
+) -> TensorFrame:
+    """Apply a row-level program to every row (``tfs.map_rows``,
+    reference ``core.py:175-211``)."""
+    program = Program.wrap(fn, fetches, feed_dict)
+    return _resolve(engine).map_rows(program, frame)
+
+
+def reduce_rows(
+    fn,
+    frame: TensorFrame,
+    fetches: Optional[Sequence[str]] = None,
+    mode: str = "tree",
+    engine: Optional[Executor] = None,
+) -> Dict[str, np.ndarray]:
+    """Pairwise-reduce all rows to one (``tfs.reduce_rows``,
+    reference ``core.py:138-173``)."""
+    program = Program.wrap(fn, fetches)
+    return _resolve(engine).reduce_rows(program, frame, mode=mode)
+
+
+def reduce_blocks(
+    fn,
+    frame: TensorFrame,
+    fetches: Optional[Sequence[str]] = None,
+    engine: Optional[Executor] = None,
+) -> Dict[str, np.ndarray]:
+    """Block-reduce then combine across blocks (``tfs.reduce_blocks``,
+    reference ``core.py:255-291``)."""
+    program = Program.wrap(fn, fetches)
+    return _resolve(engine).reduce_blocks(program, frame)
+
+
+def aggregate(
+    fn,
+    grouped: GroupedFrame,
+    fetches: Optional[Sequence[str]] = None,
+    engine: Optional[Executor] = None,
+) -> TensorFrame:
+    """Keyed algebraic aggregation (``tfs.aggregate``,
+    reference ``core.py:319-336``)."""
+    program = Program.wrap(fn, fetches)
+    return _resolve(engine).aggregate(program, grouped)

@@ -1,0 +1,202 @@
+"""Verb pre-flight validation — the ``SchemaTransforms`` layer.
+
+The reference treats validation *and its error-message quality* as half the
+product (``DebugRowOps.scala:53-275``; SURVEY.md §7 step 3 calls this out
+explicitly).  Every check here mirrors a reference check:
+
+* map verbs: each program input must name an existing, fully-analyzed,
+  device-feedable column (``DebugRowOps.scala:318-346``);
+* ``reduce_rows``: the pairwise ``x_1``/``x_2`` naming contract — for every
+  output ``x`` the program must consume exactly ``x_1`` and ``x_2`` with the
+  cell shape and dtype of column ``x`` (``DebugRowOps.scala:172-262``,
+  ``Operations.scala:86-96``);
+* ``reduce_blocks``/``aggregate``: the ``x_input`` block contract — for every
+  output ``x`` the program consumes ``x_input`` = a block of ``x`` cells and
+  emits one ``x`` cell (``DebugRowOps.scala:80-170``, ``ReduceBlockSchema``
+  at L36-40).
+
+All failures raise ``ValidationError`` with messages that name the offending
+column, list what's available, and say what to do (run ``analyze``, fix the
+name, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Tuple
+
+import jax
+
+from .. import dtypes
+from ..frame import TensorFrame
+from ..program import GraphNodeSummary, Program
+from ..schema import ColumnInfo, Schema
+from ..shape import Shape, UNKNOWN
+
+
+class ValidationError(ValueError):
+    """A verb's schema contract was violated (reference: the require(...)
+    failures in SchemaTransforms)."""
+
+
+def _column_for_input(
+    frame: TensorFrame, program: Program, input_name: str, verb: str
+) -> ColumnInfo:
+    col_name = program.column_for_input(input_name)
+    schema = frame.schema
+    if col_name not in schema:
+        raise ValidationError(
+            f"{verb}: program input {input_name!r} requests column "
+            f"{col_name!r}, which does not exist in the frame. Available "
+            f"columns: {schema.names}. (Program inputs are matched to columns "
+            f"by name; pass feed_dict={{input: column}} to rename.)"
+        )
+    ci = schema[col_name]
+    if not ci.scalar_type.device_ok:
+        raise ValidationError(
+            f"{verb}: column {col_name!r} has host-only scalar type "
+            f"{ci.scalar_type} and cannot be fed to a device program. Binary "
+            f"columns can only be carried through as passthrough outputs."
+        )
+    if not ci.is_analyzed:
+        raise ValidationError(
+            f"{verb}: column {col_name!r} has un-analyzed cell shape "
+            f"{ci.cell_shape}. Run tensorframes_tpu.analyze(frame) first, or "
+            f"construct the frame from uniform arrays."
+        )
+    return ci
+
+
+def check_map_inputs(
+    program: Program, frame: TensorFrame, verb: str
+) -> Dict[str, ColumnInfo]:
+    """Validate the inputs of map_blocks/map_rows; returns input->ColumnInfo."""
+    out = {}
+    for n in program.input_names:
+        out[n] = _column_for_input(frame, program, n, verb)
+    return out
+
+
+def check_reduce_rows(program: Program, frame: TensorFrame) -> Dict[str, ColumnInfo]:
+    """Enforce the pairwise x_1/x_2 contract; returns output name -> ColumnInfo.
+
+    Reference: ``reduceRowsSchema`` (``DebugRowOps.scala:172-262``).
+    """
+    inputs = set(program.input_names)
+    outputs: Dict[str, ColumnInfo] = {}
+    suffixed = {}
+    for n in inputs:
+        if n.endswith("_1") or n.endswith("_2"):
+            suffixed.setdefault(n[:-2], set()).add(n[-1])
+        else:
+            raise ValidationError(
+                f"reduce_rows: program input {n!r} does not follow the "
+                f"pairwise naming convention: every input must be named "
+                f"'<col>_1' or '<col>_2' (Operations.scala:86-96)."
+            )
+    for base, halves in suffixed.items():
+        if halves != {"1", "2"}:
+            raise ValidationError(
+                f"reduce_rows: column {base!r} must be consumed as BOTH "
+                f"{base}_1 and {base}_2; found only suffix(es) "
+                f"{sorted(halves)}."
+            )
+        schema = frame.schema
+        if base not in schema:
+            raise ValidationError(
+                f"reduce_rows: inputs {base}_1/{base}_2 refer to column "
+                f"{base!r}, which does not exist. Available: {schema.names}."
+            )
+        ci = schema[base]
+        if not ci.is_analyzed:
+            raise ValidationError(
+                f"reduce_rows: column {base!r} has un-analyzed cell shape "
+                f"{ci.cell_shape}; run analyze(frame) first."
+            )
+        outputs[base] = ci
+    return outputs
+
+
+def check_reduce_rows_outputs(
+    reduced: Mapping[str, ColumnInfo],
+    summaries: List[GraphNodeSummary],
+) -> None:
+    out_names = {s.name for s in summaries if s.is_output}
+    expected = set(reduced)
+    if out_names != expected:
+        raise ValidationError(
+            f"reduce_rows: program outputs {sorted(out_names)} must exactly "
+            f"match the reduced columns {sorted(expected)} (each output x is "
+            f"the combined value of x_1 and x_2)."
+        )
+    for s in summaries:
+        if s.is_output:
+            ci = reduced[s.name]
+            if tuple(s.shape) != tuple(ci.cell_shape):
+                raise ValidationError(
+                    f"reduce_rows: output {s.name!r} has shape {s.shape} but "
+                    f"column {s.name!r} has cell shape {ci.cell_shape}; a "
+                    f"pairwise reducer must preserve the cell shape."
+                )
+
+
+def check_reduce_blocks(
+    program: Program, frame: TensorFrame, verb: str = "reduce_blocks"
+) -> Dict[str, ColumnInfo]:
+    """Enforce the x_input block contract; returns output name -> ColumnInfo.
+
+    Reference: ``reduceBlocksSchema`` (``DebugRowOps.scala:80-170``).
+    """
+    outputs: Dict[str, ColumnInfo] = {}
+    for n in program.input_names:
+        if not n.endswith("_input"):
+            raise ValidationError(
+                f"{verb}: program input {n!r} does not follow the block "
+                f"naming convention: every input must be named '<col>_input' "
+                f"and consume a whole block of column <col> "
+                f"(Operations.scala:98-108)."
+            )
+        base = n[: -len("_input")]
+        schema = frame.schema
+        if base not in schema:
+            raise ValidationError(
+                f"{verb}: input {n!r} refers to column {base!r}, which does "
+                f"not exist. Available: {schema.names}."
+            )
+        ci = schema[base]
+        if not ci.is_analyzed:
+            raise ValidationError(
+                f"{verb}: column {base!r} has un-analyzed cell shape "
+                f"{ci.cell_shape}; run analyze(frame) first."
+            )
+        if not ci.scalar_type.device_ok:
+            raise ValidationError(
+                f"{verb}: column {base!r} is host-only ({ci.scalar_type}) and "
+                f"cannot be reduced on device."
+            )
+        outputs[base] = ci
+    return outputs
+
+
+def check_reduce_blocks_outputs(
+    reduced: Mapping[str, ColumnInfo],
+    summaries: List[GraphNodeSummary],
+    verb: str = "reduce_blocks",
+) -> None:
+    out_names = {s.name for s in summaries if s.is_output}
+    expected = set(reduced)
+    if out_names != expected:
+        raise ValidationError(
+            f"{verb}: program outputs {sorted(out_names)} must exactly match "
+            f"the reduced columns {sorted(expected)} (each output x is the "
+            f"block-reduction of x_input)."
+        )
+    for s in summaries:
+        if s.is_output:
+            ci = reduced[s.name]
+            if tuple(s.shape) != tuple(ci.cell_shape):
+                raise ValidationError(
+                    f"{verb}: output {s.name!r} has shape {s.shape} but column "
+                    f"{s.name!r} has cell shape {ci.cell_shape}; a block "
+                    f"reducer must emit one cell per block so the reduction "
+                    f"can be re-applied across blocks."
+                )

@@ -1,0 +1,265 @@
+"""Program: the named-input tensor program fed to every verb.
+
+TPU-native re-design of the reference's graph layer (L4): where the reference
+ships a serialized TF ``GraphDef`` whose ``Placeholder`` nodes are named after
+DataFrame columns (``TensorFlowOps.scala:101-141``), a ``Program`` here wraps a
+*jax-traceable function* whose argument names are the input names and whose
+outputs are named fetches.  Under ``jit`` the function is traced once per input
+signature and compiled by XLA — the compiled executable plays the role of the
+broadcast graph bytes (SURVEY.md §2.7 P6: program broadcast == jit cache).
+
+Three construction paths, mirroring the reference's three graph sources:
+python function (== python TF graph), the DSL (``tensorframes_tpu.dsl``), and
+frozen ``GraphDef`` import (``tensorframes_tpu.graphdef``) — the latter two
+both produce a plain traceable function and land here.
+
+``analyze_program`` is the analog of ``TensorFlowOps.analyzeGraphTF``
+(``TensorFlowOps.scala:101-141``): it runs shape inference (``jax.eval_shape``
+— no FLOPs, no device) over declared input specs and returns a
+``GraphNodeSummary`` per input/output, with user hints overriding inferred
+shapes exactly like the reference's ``ShapeDescription`` override
+(``TensorFlowOps.scala:126-133``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes
+from .dtypes import ScalarType
+from .schema import SchemaError
+from .shape import Shape
+
+
+class ProgramError(ValueError):
+    """Raised for malformed programs (bad signature, bad outputs, bad hints)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphNodeSummary:
+    """Shape/dtype summary of one program input or output.
+
+    Mirrors ``GraphNodeSummary`` (``TensorFlowOps.scala:163-169``)."""
+
+    name: str
+    is_input: bool
+    is_output: bool
+    scalar_type: ScalarType
+    shape: Shape
+
+    def __repr__(self):
+        role = "input" if self.is_input else "output"
+        return f"{self.name}[{role}]: {self.scalar_type}{self.shape}"
+
+
+class Program:
+    """A tensor program with named inputs and named outputs.
+
+    ``fn`` takes keyword arrays named by ``input_names`` and returns either a
+    ``dict`` of named outputs, a single array (allowed only when ``fetches``
+    names exactly one output), or a tuple matching ``fetches``.  Outputs are
+    canonically ordered sorted-by-name, matching the reference's output schema
+    ordering (``DebugRowOps.scala:349-372``).
+
+    ``feed_dict`` maps input name -> frame column name, the reference's
+    ``map_rows`` feed-dict contract (``core.py:175-211``,
+    ``PythonInterface.scala:120-127``).
+    """
+
+    def __init__(
+        self,
+        fn: Callable[..., Any],
+        input_names: Sequence[str],
+        fetches: Optional[Sequence[str]] = None,
+        feed_dict: Optional[Mapping[str, str]] = None,
+    ):
+        self._fn = fn
+        self._input_names = list(input_names)
+        self._declared_fetches = list(fetches) if fetches is not None else None
+        self._feed = dict(feed_dict or {})
+        for k in self._feed:
+            if k not in self._input_names:
+                raise ProgramError(
+                    f"feed_dict key {k!r} is not a program input; "
+                    f"inputs are {self._input_names}"
+                )
+        self._fetches: Optional[List[str]] = None  # resolved at first trace
+        self._jitted = None
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def wrap(
+        fn_or_program,
+        fetches: Optional[Sequence[str]] = None,
+        feed_dict: Optional[Mapping[str, str]] = None,
+    ) -> "Program":
+        if isinstance(fn_or_program, Program):
+            return fn_or_program
+        if not callable(fn_or_program):
+            raise ProgramError(
+                f"expected a callable or Program, got {type(fn_or_program).__name__}"
+            )
+        sig = inspect.signature(fn_or_program)
+        names = []
+        for p in sig.parameters.values():
+            if p.kind in (
+                inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                inspect.Parameter.KEYWORD_ONLY,
+            ):
+                names.append(p.name)
+            elif p.kind in (
+                inspect.Parameter.VAR_POSITIONAL,
+                inspect.Parameter.VAR_KEYWORD,
+            ):
+                raise ProgramError(
+                    "program functions must declare explicit named parameters "
+                    "(column names); *args/**kwargs are not allowed"
+                )
+        if not names:
+            raise ProgramError("a program needs at least one named input")
+        return Program(fn_or_program, names, fetches, feed_dict)
+
+    # -- accessors -----------------------------------------------------------
+
+    @property
+    def input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def column_for_input(self, name: str) -> str:
+        """Frame column feeding a given input (identity unless feed_dict)."""
+        return self._feed.get(name, name)
+
+    @property
+    def columns_needed(self) -> List[str]:
+        return [self.column_for_input(n) for n in self._input_names]
+
+    @property
+    def fetches(self) -> Optional[List[str]]:
+        return list(self._fetches) if self._fetches is not None else (
+            sorted(self._declared_fetches) if self._declared_fetches else None
+        )
+
+    # -- execution -----------------------------------------------------------
+
+    def _normalize_outputs(self, out) -> Dict[str, Any]:
+        if isinstance(out, dict):
+            res = dict(out)
+        elif isinstance(out, (tuple, list)):
+            if self._declared_fetches is None or len(self._declared_fetches) != len(
+                out
+            ):
+                raise ProgramError(
+                    "tuple program outputs require fetches=[...] of matching "
+                    f"length; got {len(out)} outputs, fetches="
+                    f"{self._declared_fetches}"
+                )
+            res = dict(zip(self._declared_fetches, out))
+        else:
+            if self._declared_fetches is None or len(self._declared_fetches) != 1:
+                raise ProgramError(
+                    "a program returning a single array must declare exactly "
+                    "one fetch name (pass fetches=['name']), or return a dict "
+                    "{name: array}"
+                )
+            res = {self._declared_fetches[0]: out}
+        if self._declared_fetches is not None:
+            missing = [f for f in self._declared_fetches if f not in res]
+            if missing:
+                raise ProgramError(
+                    f"program outputs {sorted(res)} are missing requested "
+                    f"fetches {missing}"
+                )
+            res = {f: res[f] for f in self._declared_fetches}
+        if not res:
+            raise ProgramError("program produced no outputs")
+        for name, v in res.items():
+            if not isinstance(name, str):
+                raise ProgramError(f"output names must be strings, got {name!r}")
+            res[name] = jnp.asarray(v)
+        # canonical order: sorted by name (DebugRowOps.scala:349-372)
+        ordered = {k: res[k] for k in sorted(res)}
+        if self._fetches is None:
+            self._fetches = list(ordered)
+        return ordered
+
+    def call(self, inputs: Mapping[str, Any]) -> Dict[str, Any]:
+        """Run the program (traceable; used inside jit/vmap/shard_map)."""
+        kwargs = {n: inputs[n] for n in self._input_names}
+        return self._normalize_outputs(self._fn(**kwargs))
+
+    def jitted(self):
+        """The compiled entry: traced once per input shape/dtype signature.
+
+        jax's jit cache is the broadcast mechanism (SURVEY.md P6): every block
+        with the same signature reuses the same XLA executable, on any device.
+        """
+        if self._jitted is None:
+            def _run(inputs):
+                return self.call(inputs)
+
+            self._jitted = jax.jit(_run)
+        return self._jitted
+
+    # -- analysis ------------------------------------------------------------
+
+    def analyze(
+        self,
+        input_specs: Mapping[str, Any],
+        hints: Optional[Mapping[str, Sequence[int]]] = None,
+    ) -> List[GraphNodeSummary]:
+        """Shape-infer the program against input specs without executing it.
+
+        ``input_specs``: input name -> (ScalarType, Shape) or ShapeDtypeStruct.
+        ``hints``: output name -> shape override (the ``ShapeDescription``
+        mechanism, ``ShapeDescription.scala:3-16``).
+        """
+        structs = {}
+        for n in self._input_names:
+            if n not in input_specs:
+                raise ProgramError(
+                    f"analyze: no spec for program input {n!r}; "
+                    f"got specs for {sorted(input_specs)}"
+                )
+            spec = input_specs[n]
+            if isinstance(spec, jax.ShapeDtypeStruct):
+                structs[n] = spec
+            else:
+                st, shape = spec
+                if not Shape(shape).is_static:
+                    raise ProgramError(
+                        f"analyze: input {n!r} spec must be static, got "
+                        f"{Shape(shape)}"
+                    )
+                structs[n] = jax.ShapeDtypeStruct(
+                    tuple(Shape(shape)), st.np_dtype
+                )
+        out_structs = jax.eval_shape(lambda ins: self.call(ins), structs)
+        hints = dict(hints or {})
+        summaries: List[GraphNodeSummary] = []
+        for n in self._input_names:
+            s = structs[n]
+            summaries.append(
+                GraphNodeSummary(
+                    n, True, False, dtypes.from_numpy(s.dtype), Shape(s.shape)
+                )
+            )
+        for name, s in out_structs.items():
+            shape = Shape(hints.pop(name)) if name in hints else Shape(s.shape)
+            summaries.append(
+                GraphNodeSummary(
+                    name, False, True, dtypes.from_numpy(s.dtype), shape
+                )
+            )
+        if hints:
+            raise ProgramError(
+                f"shape hints given for non-existent outputs: {sorted(hints)}; "
+                f"program outputs are {sorted(out_structs)}"
+            )
+        return summaries

@@ -1,0 +1,324 @@
+"""End-to-end verb tests — mirrors BasicOperationsSuite.scala / core_test.py:
+every verb x scalar/vector/matrix x single/multi-block, plus the naming
+contracts and error paths."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+
+
+def frame(data, blocks=1):
+    return tfs.analyze(tfs.TensorFrame.from_arrays(data, num_blocks=blocks))
+
+
+# ------------------------------------------------------------ map_blocks --
+
+
+def test_map_blocks_scalar_add():
+    # README walkthrough: z = x + 3 (README.md:56-87, core_test.py:39-50)
+    tf = frame({"x": np.arange(10.0)})
+    out = tfs.map_blocks(lambda x: {"z": x + 3.0}, tf)
+    assert out.column_names == ["z", "x"]
+    np.testing.assert_allclose(out.column("z").data, np.arange(10.0) + 3.0)
+    np.testing.assert_allclose(out.column("x").data, np.arange(10.0))
+
+
+def test_map_blocks_multiblock():
+    # multi-partition fixed blocks (BasicOperationsSuite.scala:189-198)
+    tf = frame({"x": np.arange(12.0)}, blocks=4)
+    out = tfs.map_blocks(lambda x: {"z": x * 2.0}, tf)
+    assert out.num_blocks == 4
+    np.testing.assert_allclose(out.column("z").data, np.arange(12.0) * 2.0)
+
+
+def test_map_blocks_vector_cells():
+    # 2-D tensor blocks (BasicOperationsSuite.scala:212-246)
+    v = np.arange(12.0).reshape(6, 2)
+    tf = frame({"v": v}, blocks=2)
+    out = tfs.map_blocks(lambda v: {"s": v.sum(axis=1)}, tf)
+    np.testing.assert_allclose(out.column("s").data, v.sum(axis=1))
+
+
+def test_map_blocks_two_inputs():
+    tf = frame({"a": np.arange(5.0), "b": np.ones(5)})
+    out = tfs.map_blocks(lambda a, b: {"z": a * b + 1.0}, tf)
+    np.testing.assert_allclose(out.column("z").data, np.arange(5.0) + 1.0)
+
+
+def test_map_blocks_output_shadows_input():
+    tf = frame({"x": np.arange(4.0)})
+    out = tfs.map_blocks(lambda x: {"x": x + 1.0}, tf)
+    assert out.column_names == ["x"]
+    np.testing.assert_allclose(out.column("x").data, np.arange(4.0) + 1.0)
+
+
+def test_map_blocks_row_count_violation():
+    tf = frame({"x": np.arange(4.0)})
+    with pytest.raises(tfs.ValidationError, match="preserve the row count"):
+        tfs.map_blocks(lambda x: {"z": x.sum(keepdims=True)}, tf)
+
+
+def test_map_blocks_trimmed_changes_count():
+    # TrimmingOperationsSuite: fewer (L17-23) and more (L25-31) rows
+    tf = frame({"x": np.arange(6.0)}, blocks=2)
+    fewer = tfs.map_blocks_trimmed(lambda x: {"z": x[:1]}, tf)
+    assert fewer.num_rows == 2  # one row per block
+    assert fewer.column_names == ["z"]  # no passthrough on trim
+    import jax.numpy as jnp
+
+    more = tfs.map_blocks_trimmed(
+        lambda x: {"z": jnp.concatenate([x, x])}, tf
+    )
+    assert more.num_rows == 12
+
+
+def test_map_blocks_unknown_column_error():
+    tf = frame({"x": np.arange(4.0)})
+    with pytest.raises(tfs.ValidationError, match="does not exist"):
+        tfs.map_blocks(lambda y: {"z": y}, tf)
+
+
+def test_map_blocks_unanalyzed_error():
+    ragged = tfs.analyze(
+        tfs.TensorFrame.from_rows([{"v": [1.0, 2.0]}, {"v": [3.0]}])
+    )
+    with pytest.raises(tfs.ValidationError, match="un-analyzed"):
+        tfs.map_blocks(lambda v: {"z": v}, ragged)
+
+
+def test_map_blocks_int_types():
+    # type matrix coverage (type_suites.scala)
+    tf = frame({"x": np.arange(5, dtype=np.int32)})
+    out = tfs.map_blocks(lambda x: {"z": x + 1}, tf)
+    assert out.column("z").data.dtype == np.int32
+    np.testing.assert_array_equal(out.column("z").data, np.arange(1, 6))
+
+
+# -------------------------------------------------------------- map_rows --
+
+
+def test_map_rows_scalar():
+    # core_test.py map_rows (L52-63)
+    tf = frame({"x": np.arange(10.0)})
+    out = tfs.map_rows(lambda x: {"z": x + 3.0}, tf)
+    np.testing.assert_allclose(out.column("z").data, np.arange(10.0) + 3.0)
+    assert out.column_names == ["z", "x"]
+
+
+def test_map_rows_vector_cell():
+    v = np.arange(12.0).reshape(4, 3)
+    tf = frame({"v": v})
+    out = tfs.map_rows(lambda v: {"n": (v * v).sum()}, tf)
+    np.testing.assert_allclose(out.column("n").data, (v * v).sum(axis=1))
+
+
+def test_map_rows_feed_dict():
+    # feed_dict renaming (core_test.py:65-76, read_image.py:164-167)
+    tf = frame({"image_data": np.arange(4.0)})
+    out = tfs.map_rows(
+        lambda contents: {"z": contents * 2.0},
+        tf,
+        feed_dict={"contents": "image_data"},
+    )
+    np.testing.assert_allclose(out.column("z").data, np.arange(4.0) * 2.0)
+
+
+# ----------------------------------------------------------- reduce_rows --
+
+
+def test_reduce_rows_sum():
+    # reduceRows sum (BasicOperationsSuite.scala:60-67): x_1 + x_2
+    tf = frame({"x": np.arange(10.0)})
+    out = tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, tf)
+    assert out["x"] == pytest.approx(45.0)
+
+
+def test_reduce_rows_multiblock_and_modes():
+    tf = frame({"x": np.arange(101.0)}, blocks=4)
+    t = tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, tf, mode="tree")
+    s = tfs.reduce_rows(
+        lambda x_1, x_2: {"x": x_1 + x_2}, tf, mode="sequential"
+    )
+    assert t["x"] == pytest.approx(5050.0)
+    assert s["x"] == pytest.approx(5050.0)
+
+
+def test_reduce_rows_min_vector():
+    v = np.array([[3.0, 1.0], [2.0, 5.0], [4.0, 0.0]])
+    tf = frame({"v": v})
+    import jax.numpy as jnp
+
+    out = tfs.reduce_rows(
+        lambda v_1, v_2: {"v": jnp.minimum(v_1, v_2)}, tf
+    )
+    np.testing.assert_allclose(out["v"], [2.0, 0.0])
+
+
+def test_reduce_rows_two_columns():
+    tf = frame({"a": np.arange(5.0), "b": np.ones(5)})
+    out = tfs.reduce_rows(
+        lambda a_1, a_2, b_1, b_2: {"a": a_1 + a_2, "b": b_1 * b_2}, tf
+    )
+    assert out["a"] == pytest.approx(10.0)
+    assert out["b"] == pytest.approx(1.0)
+
+
+def test_reduce_rows_bad_naming():
+    tf = frame({"x": np.arange(4.0)})
+    with pytest.raises(tfs.ValidationError, match="pairwise naming"):
+        tfs.reduce_rows(lambda x: {"x": x}, tf)
+    with pytest.raises(tfs.ValidationError, match="BOTH"):
+        tfs.reduce_rows(lambda x_1: {"x": x_1}, tf)
+
+
+def test_reduce_rows_shape_violation():
+    tf = frame({"x": np.arange(4.0)})
+    with pytest.raises(tfs.ValidationError, match="cell shape"):
+        tfs.reduce_rows(
+            lambda x_1, x_2: {"x": (x_1 + x_2).reshape(1)}, tf
+        )
+
+
+# --------------------------------------------------------- reduce_blocks --
+
+
+def test_reduce_blocks_sum():
+    # README.md:92-124: reduce_sum over analyzed column
+    tf = frame({"x": np.arange(10.0)}, blocks=3)
+    out = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(axis=0)}, tf)
+    assert out["x"] == pytest.approx(45.0)
+
+
+def test_reduce_blocks_min_vector():
+    v = np.array([[3.0, 1.0], [2.0, 5.0], [4.0, 0.0], [9.0, 9.0]])
+    tf = frame({"v": v}, blocks=2)
+    out = tfs.reduce_blocks(
+        lambda v_input: {"v": v_input.min(axis=0)}, tf
+    )
+    np.testing.assert_allclose(out["v"], [2.0, 0.0])
+
+
+def test_reduce_blocks_bad_naming():
+    tf = frame({"x": np.arange(4.0)})
+    with pytest.raises(tfs.ValidationError, match="_input"):
+        tfs.reduce_blocks(lambda x: {"x": x.sum(axis=0)}, tf)
+
+
+def test_reduce_blocks_output_mismatch():
+    tf = frame({"x": np.arange(4.0)})
+    with pytest.raises(tfs.ValidationError, match="exactly match"):
+        tfs.reduce_blocks(
+            lambda x_input: {"y": x_input.sum(axis=0)}, tf
+        )
+
+
+# -------------------------------------------------------------- aggregate --
+
+
+def test_aggregate_sum_by_key():
+    # groupBy aggregate (BasicOperationsSuite.scala:200-210, core_test.py:118-127)
+    tf = frame(
+        {
+            "key": np.array([1, 2, 1, 2, 1], dtype=np.int64),
+            "x": np.array([1.0, 10.0, 2.0, 20.0, 3.0]),
+        }
+    )
+    out = tfs.aggregate(
+        lambda x_input: {"x": x_input.sum(axis=0)}, tf.group_by("key")
+    )
+    rows = {int(r["key"]): float(r["x"]) for r in out.collect()}
+    assert rows == {1: pytest.approx(6.0), 2: pytest.approx(30.0)}
+
+
+def test_aggregate_vector_cells_and_uneven_groups():
+    keys = np.array([0, 0, 1, 2, 2, 2], dtype=np.int64)
+    v = np.arange(12.0).reshape(6, 2)
+    tf = frame({"k": keys, "v": v})
+    out = tfs.aggregate(
+        lambda v_input: {"v": v_input.sum(axis=0)}, tf.group_by("k")
+    )
+    got = {int(r["k"]): r["v"] for r in out.collect()}
+    np.testing.assert_allclose(got[0], v[0] + v[1])
+    np.testing.assert_allclose(got[1], v[2])
+    np.testing.assert_allclose(got[2], v[3] + v[4] + v[5])
+
+
+def test_aggregate_multi_key():
+    tf = frame(
+        {
+            "k1": np.array([0, 0, 1, 1], dtype=np.int64),
+            "k2": np.array([0, 1, 0, 0], dtype=np.int64),
+            "x": np.array([1.0, 2.0, 3.0, 4.0]),
+        }
+    )
+    out = tfs.aggregate(
+        lambda x_input: {"x": x_input.sum(axis=0)}, tf.group_by("k1", "k2")
+    )
+    got = {
+        (int(r["k1"]), int(r["k2"])): float(r["x"]) for r in out.collect()
+    }
+    assert got == {(0, 0): 1.0, (0, 1): 2.0, (1, 0): 7.0}
+
+
+def test_aggregate_non_reducing_program_error():
+    tf = frame(
+        {
+            "k": np.array([1, 1, 2, 2], dtype=np.int64),
+            "x": np.array([1.0, 2.0, 3.0, 4.0]),
+        }
+    )
+    with pytest.raises(tfs.ValidationError, match="emit one cell"):
+        tfs.aggregate(lambda x_input: {"x": x_input + 1.0}, tf.group_by("k"))
+
+
+def test_reduce_empty_frame_errors():
+    empty = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"x": np.array([], dtype=np.float64)})
+    )
+    with pytest.raises(tfs.ValidationError, match="empty frame"):
+        tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, empty)
+    with pytest.raises(tfs.ValidationError, match="empty frame"):
+        tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(axis=0)}, empty)
+
+
+def test_aggregate_key_is_reduced_error():
+    tf = frame({"k": np.array([1, 2], dtype=np.int64)})
+    with pytest.raises(tfs.ValidationError, match="grouping key"):
+        tfs.aggregate(
+            lambda k_input: {"k": k_input.sum(axis=0)}, tf.group_by("k")
+        )
+
+
+# ---------------------------------------------------------------- program --
+
+
+def test_program_fetch_forms():
+    tf = frame({"x": np.arange(3.0)})
+    # single array + fetches name
+    out = tfs.map_blocks(lambda x: x + 1.0, tf, fetches=["z"])
+    np.testing.assert_allclose(out.column("z").data, np.arange(3.0) + 1.0)
+    # tuple + fetches
+    out2 = tfs.map_blocks(
+        lambda x: (x + 1.0, x * 2.0), tf, fetches=["a", "b"]
+    )
+    np.testing.assert_allclose(out2.column("a").data, np.arange(3.0) + 1.0)
+    np.testing.assert_allclose(out2.column("b").data, np.arange(3.0) * 2.0)
+    # missing fetch name -> error
+    with pytest.raises(tfs.ProgramError):
+        tfs.map_blocks(lambda x: x + 1.0, tf)
+
+
+def test_program_analyze_summaries():
+    p = tfs.Program.wrap(lambda x: {"z": x + 1.0})
+    import tensorframes_tpu.dtypes as dt
+
+    summ = p.analyze({"x": (dt.float32, (8,))})
+    by_name = {s.name: s for s in summ}
+    assert by_name["x"].is_input and by_name["z"].is_output
+    assert by_name["z"].shape == (8,)
+    # hint override (ShapeDescription mechanism)
+    summ2 = p.analyze({"x": (dt.float32, (8,))}, hints={"z": (-1,)})
+    assert {s.name: s for s in summ2}["z"].shape == (tfs.UNKNOWN,)
+    with pytest.raises(tfs.ProgramError, match="non-existent"):
+        p.analyze({"x": (dt.float32, (8,))}, hints={"nope": (1,)})

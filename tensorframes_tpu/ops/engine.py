@@ -125,7 +125,7 @@ class Executor:
                         f"{ {k: v.shape for k, v in host.items()} }"
                     )
             out_blocks.append(host)
-        return self._build_map_output(frame, program, out_blocks, trim)
+        return self._build_map_output(frame, out_blocks, trim)
 
     def map_rows(
         self, program: Program, frame: TensorFrame
@@ -140,14 +140,23 @@ class Executor:
             inputs = self._device_inputs(program, block, infos)
             outs = vmapped(inputs)
             out_blocks.append({k: _np(v) for k, v in outs.items()})
-        return self._build_map_output(frame, program, out_blocks, trim=False)
+        return self._build_map_output(frame, out_blocks, trim=False)
+
+    def _column_array(
+        self, frame: TensorFrame, col_name: str, ci: ColumnInfo
+    ) -> np.ndarray:
+        """Load a column as a contiguous host array in its device dtype."""
+        st = dtypes.coerce(ci.scalar_type)
+        return np.asarray(frame.column(col_name).data).astype(
+            st.np_dtype, copy=False
+        )
 
     def _build_map_output(
         self,
         frame: TensorFrame,
-        program: Program,
         out_blocks: List[Dict[str, np.ndarray]],
         trim: bool,
+        offsets: Optional[Sequence[int]] = None,
     ) -> TensorFrame:
         out_frame = TensorFrame.from_blocks(out_blocks)
         if trim:
@@ -162,7 +171,9 @@ class Executor:
         for cname in frame.column_names:
             if cname not in shadowed:
                 cols.append(frame.column(cname))
-        return TensorFrame(cols, out_frame.offsets)
+        return TensorFrame(
+            cols, offsets if offsets is not None else out_frame.offsets
+        )
 
     # ------------------------------------------------------------- reduce --
 
@@ -216,11 +227,12 @@ class Executor:
         out, _ = jax.lax.scan(step, init, rest)
         return out
 
-    def reduce_rows(
-        self, program: Program, frame: TensorFrame, mode: str = "tree"
-    ) -> Dict[str, np.ndarray]:
-        """``reduceRows`` (``DebugRowOps.scala:479-501``): pairwise-fold all
-        rows of the named columns down to one row."""
+    def _reduce_rows_setup(
+        self, program: Program, frame: TensorFrame, mode: str
+    ):
+        """Shared pre-flight for reduce_rows (single-device and mesh): checks
+        the pairwise contract and returns ``(bases, reduced, run)`` where
+        ``run`` jit-folds a dict of block arrays down to one cell each."""
         if frame.num_rows == 0:
             raise ValidationError(
                 "reduce_rows: cannot reduce an empty frame (no identity "
@@ -251,6 +263,14 @@ class Executor:
         def run(arrs):
             return fold(pairfn, arrs)
 
+        return bases, reduced, run
+
+    def reduce_rows(
+        self, program: Program, frame: TensorFrame, mode: str = "tree"
+    ) -> Dict[str, np.ndarray]:
+        """``reduceRows`` (``DebugRowOps.scala:479-501``): pairwise-fold all
+        rows of the named columns down to one row."""
+        bases, reduced, run = self._reduce_rows_setup(program, frame, mode)
         partials: List[Dict[str, jnp.ndarray]] = []
         for bi in range(frame.num_blocks):
             if frame.block_sizes[bi] == 0:
@@ -273,18 +293,19 @@ class Executor:
             final = run(stacked)
         return {b: _np(final[b]) for b in bases}
 
-    def reduce_blocks(
-        self, program: Program, frame: TensorFrame
-    ) -> Dict[str, np.ndarray]:
-        """``reduceBlocks`` (``DebugRowOps.scala:503-526``): phase 1 reduces
-        each block to one row with the user's block program; phase 2 re-applies
-        the same program once to the stacked per-block partials."""
+    def _reduce_blocks_setup(
+        self, program: Program, frame: TensorFrame, verb: str = "reduce_blocks"
+    ):
+        """Shared pre-flight for reduce_blocks/aggregate-style programs:
+        checks the x_input contract and returns ``(bases, reduced, run)``
+        where ``run`` jit-applies the block program to a dict of block
+        arrays keyed by base column name."""
         if frame.num_rows == 0:
             raise ValidationError(
-                "reduce_blocks: cannot reduce an empty frame (no identity "
-                "element is available for an arbitrary block program)"
+                f"{verb}: cannot reduce an empty frame (no identity "
+                f"element is available for an arbitrary block program)"
             )
-        reduced = validation.check_reduce_blocks(program, frame)
+        reduced = validation.check_reduce_blocks(program, frame, verb=verb)
         bases = sorted(reduced)
         # analyze at an arbitrary static block size to validate the contract
         probe = max(frame.block_sizes) or 1
@@ -297,12 +318,21 @@ class Executor:
                 for b in bases
             }
         )
-        validation.check_reduce_blocks_outputs(reduced, summaries)
+        validation.check_reduce_blocks_outputs(reduced, summaries, verb=verb)
 
         def block_call(arrs: Dict[str, jnp.ndarray]):
             return program.call({f"{b}_input": arrs[b] for b in bases})
 
         run = jax.jit(block_call)
+        return bases, reduced, run
+
+    def reduce_blocks(
+        self, program: Program, frame: TensorFrame
+    ) -> Dict[str, np.ndarray]:
+        """``reduceBlocks`` (``DebugRowOps.scala:503-526``): phase 1 reduces
+        each block to one row with the user's block program; phase 2 re-applies
+        the same program once to the stacked per-block partials."""
+        bases, reduced, run = self._reduce_blocks_setup(program, frame)
         partials: List[Dict[str, jnp.ndarray]] = []
         for bi in range(frame.num_blocks):
             if frame.block_sizes[bi] == 0:
@@ -324,6 +354,15 @@ class Executor:
         return {b: _np(final[b]) for b in bases}
 
     # ---------------------------------------------------------- aggregate --
+
+    def _run_groups(
+        self, vrun, batch: Dict[str, np.ndarray]
+    ) -> Dict[str, jnp.ndarray]:
+        """Run the vmapped block program over one [groups, size, *cell]
+        bucket.  The mesh executor overrides this to shard (and pad) the
+        groups axis — groups are independent under vmap, so padding is
+        semantics-safe there, unlike frame rows."""
+        return vrun({b: jnp.asarray(v) for b, v in batch.items()})
 
     def aggregate(
         self, program: Program, grouped: GroupedFrame
@@ -400,8 +439,8 @@ class Executor:
             gather = np.empty((len(gids), size), dtype=np.int64)
             for i, g in enumerate(gids):
                 gather[i] = np.arange(starts[g], starts[g] + size)
-            batch = {b: jnp.asarray(data[b][gather]) for b in bases}
-            outs = vrun(batch)  # dict base -> [num_gids, *cell]
+            batch = {b: data[b][gather] for b in bases}
+            outs = self._run_groups(vrun, batch)  # dict base -> [num_gids, *cell]
             for b in bases:
                 host = _np(outs[b])
                 for i, g in enumerate(gids):

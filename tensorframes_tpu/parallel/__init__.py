@@ -1,0 +1,16 @@
+"""Multi-device execution: mesh utilities + the distributed executor.
+
+Replaces the reference's distribution substrate (Apache Spark, SURVEY.md §2.7)
+with XLA collectives over a ``jax.sharding.Mesh``:
+
+* P1 data parallelism over partitions -> blocks sharded over the mesh's data
+  axis;
+* P4 driver-coordinated pairwise reduce -> on-device tree / ``psum`` over ICI;
+* P5 shuffle-grouped aggregation -> device-side keyed reduction;
+* P6 program broadcast -> the jit cache (PJRT ships the executable).
+"""
+
+from .dist import MeshExecutor
+from .mesh import data_mesh, device_count, training_mesh
+
+__all__ = ["MeshExecutor", "data_mesh", "device_count", "training_mesh"]

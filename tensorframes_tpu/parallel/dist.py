@@ -1,0 +1,331 @@
+"""MeshExecutor: the verbs over a device mesh.
+
+This is the replacement for the reference's entire distribution story
+(SURVEY.md §2.7): where the reference runs one TF session per Spark partition
+and moves all cross-partition data through Spark shuffles and driver-side
+``RDD.reduce`` (its main performance ceiling, SURVEY.md §5), the MeshExecutor
+keeps every byte on the mesh and lets XLA place the collectives on ICI.
+
+Two execution modes, because the reference's per-partition semantics and the
+TPU-natural global semantics genuinely differ for cross-row programs:
+
+* ``mode="global"`` (default, fastest): the whole frame is ONE logical block,
+  batch-sharded over the data axis.  The program is jit-compiled against the
+  global shape; GSPMD partitions it and inserts ``psum``/``all-gather`` where
+  the program mixes rows.  ``reduce_blocks`` becomes a single sharded
+  execution whose cross-device combine is an ICI allreduce — the direct
+  replacement of the reference's two-phase Spark reduce
+  (``DebugRowOps.scala:503-526`` -> one XLA program).
+* ``mode="per_block"``: reference-faithful partition semantics via
+  ``shard_map`` — each device applies the program to its local block
+  independently (a cross-row op like ``mean`` is per-block, exactly like a
+  per-partition TF session).  ``reduce_blocks`` does the local phase inside
+  ``shard_map`` and re-applies the program to the gathered per-device partials
+  (the reference's pairwise combine tree, ``DebugRowOps.scala:732-750``,
+  collapsed into one call).
+
+Multi-host: the same code runs under ``jax.distributed`` — ``jax.devices()``
+spans all hosts, the mesh covers the pod, and GSPMD splits collectives into
+ICI (intra-slice) and DCN (inter-slice) phases.  Nothing here is
+host-count-aware by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..frame import TensorFrame
+from ..ops import validation
+from ..ops.engine import Executor, _np
+from ..ops.validation import ValidationError
+from ..program import Program
+from .mesh import data_mesh
+
+import logging
+
+_log = logging.getLogger("tensorframes_tpu.parallel")
+
+
+class MeshExecutor(Executor):
+    """Distributed verb executor over a ``jax.sharding.Mesh``."""
+
+    def __init__(
+        self,
+        mesh: Optional[Mesh] = None,
+        mode: str = "global",
+        data_axis: str = "dp",
+    ):
+        if mode not in ("global", "per_block"):
+            raise ValidationError(
+                f"MeshExecutor mode must be 'global' or 'per_block', got "
+                f"{mode!r}"
+            )
+        self.mesh = mesh if mesh is not None else data_mesh()
+        if data_axis not in self.mesh.axis_names:
+            raise ValidationError(
+                f"data axis {data_axis!r} not in mesh axes "
+                f"{self.mesh.axis_names}"
+            )
+        self.mode = mode
+        self.axis = data_axis
+
+    # -- helpers -------------------------------------------------------------
+
+    @property
+    def _num_shards(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def _shard(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.axis))
+
+    def _shard_for(self, n: int) -> NamedSharding:
+        """Sharding for a lead dimension of size ``n``.
+
+        XLA requires the partitioned axis to divide evenly; arbitrary user
+        programs may be cross-row, so padding is NOT semantics-preserving
+        (SURVEY.md §7 hard part 1).  When ``n`` is not divisible by the mesh's
+        data axis we fall back to the largest divisor of ``n`` that fits —
+        correctness first, with a logged hint to size batches divisibly."""
+        d = self._num_shards
+        if n % d == 0:
+            return self._shard()
+        dd = d
+        while n % dd:
+            dd -= 1
+        _log.warning(
+            "row count %d is not divisible by the %d-device data axis; "
+            "executing on %d device(s). Size row counts as a multiple of "
+            "the mesh for full parallelism.",
+            n,
+            d,
+            dd,
+        )
+        devs = np.asarray(self.mesh.devices).reshape(-1)[:dd]
+        sub = Mesh(devs, (self.axis,))
+        return NamedSharding(sub, P(self.axis))
+
+    def _global_inputs(
+        self, program: Program, frame: TensorFrame, infos
+    ) -> Dict[str, jnp.ndarray]:
+        """Whole columns -> device, batch-sharded on the data axis.
+
+        One contiguous transfer per column (the reference's per-row
+        ``TensorConverter`` appends, ``datatypes.scala:93-127``, become a
+        single ``device_put``)."""
+        sh = self._shard_for(frame.num_rows)
+        return {
+            n: jax.device_put(
+                self._column_array(frame, program.column_for_input(n), infos[n]),
+                sh,
+            )
+            for n in program.input_names
+        }
+
+    def _finish_map(
+        self, frame: TensorFrame, host: Dict[str, np.ndarray], trim: bool
+    ) -> TensorFrame:
+        # non-trimmed output keeps the caller's logical partitioning
+        return self._build_map_output(
+            frame, [host], trim, offsets=None if trim else frame.offsets
+        )
+
+    # -- map verbs -----------------------------------------------------------
+
+    def map_blocks(
+        self, program: Program, frame: TensorFrame, trim: bool = False
+    ) -> TensorFrame:
+        infos = validation.check_map_inputs(program, frame, "map_blocks")
+        n = frame.num_rows
+        if self.mode == "per_block":
+            return self._map_blocks_shardmap(program, frame, infos, trim)
+        inputs = self._global_inputs(program, frame, infos)
+        outs = program.jitted()(inputs)
+        host = {k: _np(v) for k, v in outs.items()}
+        if not trim:
+            for name, v in host.items():
+                if v.ndim == 0 or v.shape[0] != n:
+                    raise ValidationError(
+                        f"map_blocks: output {name!r} has shape {v.shape} but "
+                        f"the frame has {n} rows; a non-trimmed map must "
+                        f"preserve the row count (use map_blocks_trimmed)."
+                    )
+        return self._finish_map(frame, host, trim)
+
+    def _map_blocks_shardmap(
+        self, program: Program, frame: TensorFrame, infos, trim: bool
+    ) -> TensorFrame:
+        """Reference per-partition semantics: one program application per
+        device-local block via shard_map (SURVEY.md P1)."""
+        d = self._num_shards
+        n = frame.num_rows
+        n_even = (n // d) * d
+        if n_even == 0:
+            raise ValidationError(
+                f"map_blocks(per_block): frame has {n} rows < {d} devices; "
+                f"use the global mode or fewer devices"
+            )
+        local = jax.shard_map(
+            lambda ins: program.call(ins),
+            mesh=self.mesh,
+            in_specs=P(self.axis),
+            out_specs=P(self.axis),
+            check_vma=False,
+        )
+        sh = self._shard()
+        inputs = {}
+        tail_inputs = {}
+        for name in program.input_names:
+            arr = self._column_array(
+                frame, program.column_for_input(name), infos[name]
+            )
+            inputs[name] = jax.device_put(arr[:n_even], sh)
+            if n_even < n:
+                tail_inputs[name] = jnp.asarray(arr[n_even:])
+        outs = jax.jit(local)(inputs)
+        host = {k: _np(v) for k, v in outs.items()}
+        if tail_inputs:
+            # remainder rows form one extra block, run unsharded
+            tail_out = program.jitted()(tail_inputs)
+            host = {
+                k: np.concatenate([host[k], _np(tail_out[k])]) for k in host
+            }
+        if not trim:
+            for name, v in host.items():
+                if v.ndim == 0 or v.shape[0] != n:
+                    raise ValidationError(
+                        f"map_blocks(per_block): output {name!r} has shape "
+                        f"{v.shape}, expected lead dim {n}"
+                    )
+        return self._finish_map(frame, host, trim)
+
+    def map_rows(self, program: Program, frame: TensorFrame) -> TensorFrame:
+        """Row semantics are partition-independent, so both modes vmap over
+        the globally sharded batch (``DebugRowOps.scala:819-857`` -> vmap).
+        Rows are independent under vmap, so uneven row counts are padded to a
+        mesh multiple (and trimmed after) instead of under-sharding."""
+        infos = validation.check_map_inputs(program, frame, "map_rows")
+        n = frame.num_rows
+        pad = (-n) % self._num_shards
+        sh = self._shard()
+        inputs = {}
+        for name in program.input_names:
+            arr = self._column_array(
+                frame, program.column_for_input(name), infos[name]
+            )
+            if pad:
+                arr = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)])
+            inputs[name] = jax.device_put(arr, sh)
+        vmapped = jax.jit(jax.vmap(lambda ins: program.call(ins)))
+        outs = vmapped(inputs)
+        host = {k: _np(v)[:n] for k, v in outs.items()}
+        return self._finish_map(frame, host, trim=False)
+
+    # -- reduce verbs ---------------------------------------------------------
+
+    def reduce_rows(
+        self, program: Program, frame: TensorFrame, mode: str = "tree"
+    ) -> Dict[str, np.ndarray]:
+        """Pairwise tree over the sharded global batch: the fold's upper
+        levels cross shard boundaries and lower onto ICI collectives — the
+        replacement for the reference's driver-side ``RDD.reduce``
+        (``DebugRowOps.scala:500``, SURVEY.md P4)."""
+        bases, reduced, run = self._reduce_rows_setup(program, frame, mode)
+        sh = self._shard_for(frame.num_rows)
+        arrays = {
+            b: jax.device_put(self._column_array(frame, b, reduced[b]), sh)
+            for b in bases
+        }
+        final = run(arrays)
+        return {b: _np(final[b]) for b in bases}
+
+    def reduce_blocks(
+        self, program: Program, frame: TensorFrame
+    ) -> Dict[str, np.ndarray]:
+        bases, reduced, run = self._reduce_blocks_setup(program, frame)
+        if self.mode == "global":
+            sh = self._shard_for(frame.num_rows)
+            # ONE sharded execution; GSPMD turns the program's lead-axis
+            # reduction into local partials + ICI allreduce automatically.
+            arrays = {
+                b: jax.device_put(self._column_array(frame, b, reduced[b]), sh)
+                for b in bases
+            }
+            final = run(arrays)
+            return {b: _np(final[b]) for b in bases}
+        # per_block: local reduce inside shard_map, then re-apply the program
+        # to the D stacked partials (reference phase 2, DebugRowOps.scala:524)
+        d = self._num_shards
+        n = frame.num_rows
+        n_even = (n // d) * d
+        if n_even == 0:
+            raise ValidationError(
+                f"reduce_blocks(per_block): frame has {n} rows < {d} devices"
+            )
+
+        sh = self._shard()  # n_even is divisible by construction
+
+        def local(arrs):
+            out = program.call(
+                {f"{b}_input": arrs[b] for b in bases}
+            )
+            return {k: v[None] for k, v in out.items()}
+
+        localized = jax.shard_map(
+            local,
+            mesh=self.mesh,
+            in_specs=P(self.axis),
+            out_specs=P(self.axis),
+            check_vma=False,
+        )
+        arrays = {}
+        tails = {}
+        for b in bases:
+            arr = self._column_array(frame, b, reduced[b])
+            arrays[b] = jax.device_put(arr[:n_even], sh)
+            if n_even < n:
+                tails[b] = jnp.asarray(arr[n_even:])
+        partials = jax.jit(localized)(arrays)  # dict base -> [d, *cell]
+        # partials are d rows — host-stack them (cheap) so the final combine
+        # runs unsharded, mirroring the reference's phase-2 combine
+        stacked = {b: _np(partials[b]) for b in bases}
+        if tails:
+            tail_part = run(tails)
+            stacked = {
+                b: np.concatenate([stacked[b], _np(tail_part[b])[None]])
+                for b in bases
+            }
+        final = run({b: jnp.asarray(v) for b, v in stacked.items()})
+        return {b: _np(final[b]) for b in bases}
+
+    # -- aggregate ------------------------------------------------------------
+    #
+    # ``aggregate`` reuses the single-device implementation wholesale (the
+    # host group-index build is device-agnostic, SURVEY.md P5); only the
+    # execution of each size-bucketed [groups, size, *cell] batch changes —
+    # the groups axis is padded to a mesh multiple (groups are independent
+    # under vmap, so padding is semantics-safe) and sharded over ``dp``:
+    # every device reduces its slice of the key space in parallel, no Spark
+    # shuffle.
+
+    def _run_groups(
+        self, vrun, batch: Dict[str, np.ndarray]
+    ) -> Dict[str, jnp.ndarray]:
+        d = self._num_shards
+        g = next(iter(batch.values())).shape[0]
+        pad = (-g) % d
+        sh = self._shard()
+        placed = {}
+        for b, arr in batch.items():
+            if pad:
+                arr = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)])
+            placed[b] = jax.device_put(arr, sh)
+        outs = vrun(placed)
+        if pad:
+            # slicing a sharded array on host requires materialisation anyway
+            outs = {k: _np(v)[:g] for k, v in outs.items()}
+        return outs

@@ -1,0 +1,62 @@
+"""Mesh construction helpers.
+
+The framework's standard mesh axes, following the scaling-book naming that the
+model/training layer shares (``tensorframes_tpu.models`` / ``train``):
+
+* ``dp``  — data parallelism (the verb engine shards blocks over this axis;
+  the TPU equivalent of Spark partition parallelism, SURVEY.md §2.7 P1);
+* ``tp``  — tensor parallelism (model layer);
+* ``sp``  — sequence/context parallelism (ring attention, model layer);
+* ``pp``  — pipeline stages (model layer).
+
+On a single slice all axes ride ICI; across slices the outermost axis maps to
+DCN (jax device order puts slice-local devices adjacent, so inner axes stay on
+ICI — the layout recipe from the scaling book).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import AxisType, Mesh
+
+
+def device_count() -> int:
+    """Global device count across all hosts (``jax.devices()`` spans the
+    pod under ``jax.distributed``)."""
+    return len(jax.devices())
+
+
+def data_mesh(num_devices: Optional[int] = None) -> Mesh:
+    """A 1-D mesh over the data axis — the verb engine's default.
+
+    Axis type is ``Auto``: the verbs run *arbitrary user programs* whose
+    intermediate shapes XLA must be free to re-partition (slices, gathers,
+    uneven splits); ``Explicit`` sharding-in-types would reject legal
+    programs at trace time.
+    """
+    n = num_devices or device_count()
+    return jax.make_mesh((n,), ("dp",), axis_types=(AxisType.Auto,))
+
+
+def training_mesh(
+    dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1
+) -> Mesh:
+    """A 4-axis mesh for the training stack; total must equal device count.
+
+    Axis order (outermost first) is ``pp, dp, sp, tp`` so that tensor
+    parallelism — the most communication-intensive axis — maps to the
+    innermost (fastest, ICI-adjacent) devices.
+    """
+    n = pp * dp * sp * tp
+    if n != device_count():
+        raise ValueError(
+            f"mesh size pp*dp*sp*tp = {n} != available devices "
+            f"{device_count()}"
+        )
+    return jax.make_mesh(
+        (pp, dp, sp, tp),
+        ("pp", "dp", "sp", "tp"),
+        axis_types=(AxisType.Auto,) * 4,
+    )

@@ -17,6 +17,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# The axon environment's sitecustomize force-registers the TPU backend and
+# overwrites jax_platforms AFTER env vars are read, so the env var alone is
+# not enough — re-pin to cpu post-import to get the virtual 8-device mesh.
+jax.config.update("jax_platforms", "cpu")
+
 # The reference computes in float64 by default (python floats -> Double,
 # datatypes.scala:328-387).  Enable x64 on the CPU test mesh so dtype-fidelity
 # tests exercise the full registry; TPU runs use f32/bf16 regardless.

@@ -18,7 +18,9 @@ The user-facing module mirrors the reference's python API
     s = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, tf)
 """
 
+from . import dsl
 from .analyze import analyze, explain, print_schema
+from .dsl import block, row
 from .dtypes import ScalarType, by_name as scalar_type, supported_types
 from .frame import TensorFrame
 from .ops import (
@@ -45,6 +47,9 @@ def map_blocks_trimmed(fn, frame, **kw):
 
 
 __all__ = [
+    "dsl",
+    "block",
+    "row",
     "analyze",
     "explain",
     "print_schema",

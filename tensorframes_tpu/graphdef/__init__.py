@@ -1,0 +1,25 @@
+"""GraphDef import: run frozen TF models as XLA programs — no TensorFlow dep.
+
+The reference's whole execution model is "ship a serialized ``GraphDef`` to
+the runtime" (``TensorFlowOps.scala:101-141``; the frozen-model scoring flow
+``read_image.py:108-167`` is benchmark configs #3/#4 in BASELINE.json).  The
+TPU-native equivalent keeps GraphDef as an *interchange* format only: a
+minimal pure-python protobuf wire codec (``wire.py``/``proto.py``) parses the
+graph, and ``importer.py`` lowers the node graph onto jax ops
+(``ops.py`` registry), producing the same :class:`~tensorframes_tpu.program.Program`
+every verb consumes.  Internally the IR is the jaxpr — protos never reach the
+device (SURVEY.md §2.6).
+"""
+
+from .importer import import_graphdef, load_graphdef
+from .proto import AttrValue, GraphDef, NodeDef, TensorProto, parse_graphdef
+
+__all__ = [
+    "import_graphdef",
+    "load_graphdef",
+    "parse_graphdef",
+    "GraphDef",
+    "NodeDef",
+    "AttrValue",
+    "TensorProto",
+]

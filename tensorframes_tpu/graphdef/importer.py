@@ -1,0 +1,237 @@
+"""Lower a parsed GraphDef to a :class:`~tensorframes_tpu.program.Program`.
+
+The analog of the reference's ``analyzeGraphTF`` + session execution
+(``TensorFlowOps.scala:101-141``, ``DebugRowOps.scala:783-801``): inputs are
+the graph's ``Placeholder`` nodes (zero-input nodes of placeholder type —
+same identification rule as ``TensorFlowOps.scala:106-108``), outputs are the
+requested fetches, and the node graph is evaluated lazily over jax values.
+
+Constant folding falls out of the evaluation model: ``Const`` nodes produce
+host numpy arrays, numpy-only subgraphs stay numpy (TF graphs encode shape /
+reduction-index operands as Const inputs), and only values derived from
+placeholders become traced jax values.  Ops that structurally require static
+operands (Reshape targets, axes, paddings) therefore see real numpy arrays
+whenever the graph is a legal frozen graph.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .. import dtypes as dt
+from ..program import Program, ProgramError
+from ..shape import Shape
+from . import ops as op_registry
+from .proto import GraphDef, NodeDef, TensorProto, parse_graphdef
+
+_PLACEHOLDER_OPS = ("Placeholder", "PlaceholderV2", "PlaceholderWithDefault")
+
+
+class GraphImportError(ValueError):
+    """The GraphDef cannot be lowered (unknown op, bad fetch, cycle...)."""
+
+
+def load_graphdef(source: Union[str, bytes, os.PathLike]) -> GraphDef:
+    """Load from serialized bytes or a ``.pb`` file path (the reference's two
+    ingestion paths: ``PythonOpBuilder.graph``/``graphFromFile``,
+    ``PythonInterface.scala:110-118``)."""
+    if isinstance(source, (str, os.PathLike)):
+        with open(source, "rb") as f:
+            data = f.read()
+    else:
+        data = bytes(source)
+    return parse_graphdef(data)
+
+
+def _split_ref(ref: str) -> Tuple[str, int]:
+    if ref.startswith("^"):  # control dependency — ordering only, no data
+        return ref[1:], -1
+    if ":" in ref:
+        name, idx = ref.rsplit(":", 1)
+        return name, int(idx)
+    return ref, 0
+
+
+def import_graphdef(
+    graph: Union[GraphDef, bytes, str, os.PathLike],
+    fetches: Sequence[str],
+    inputs: Optional[Mapping[str, str]] = None,
+) -> Program:
+    """Build a Program from a frozen GraphDef.
+
+    ``fetches``: output tensor names (``"out"`` or ``"out:0"``).
+    ``inputs``: placeholder name -> frame column (the reference feed-dict,
+    ``PythonInterface.scala:120-127``).
+    """
+    if not isinstance(graph, GraphDef):
+        graph = load_graphdef(graph)
+    nodes = graph.node_map()
+    if not nodes:
+        raise GraphImportError("GraphDef has no nodes")
+
+    fetch_list: List[Tuple[str, str, int]] = []
+    for f in fetches:
+        name, idx = _split_ref(f)
+        if name not in nodes:
+            raise GraphImportError(
+                f"fetch {f!r} not found in graph; nodes: "
+                f"{sorted(nodes)[:20]}{'...' if len(nodes) > 20 else ''}"
+            )
+        out_name = name if idx == 0 else f"{name}_{idx}"
+        fetch_list.append((out_name, name, idx))
+    if not fetch_list:
+        raise GraphImportError("no fetches requested")
+
+    # prune to the transitive closure of the fetches (TF session pruning —
+    # placeholders outside the closure must not become required inputs)
+    reachable: set = set()
+    stack = [name for _, name, _ in fetch_list]
+    while stack:
+        cur = stack.pop()
+        if cur in reachable:
+            continue
+        reachable.add(cur)
+        node = nodes.get(cur)
+        if node is not None:
+            for ref in node.inputs:
+                rn, _ = _split_ref(ref)
+                stack.append(rn)
+    placeholders: List[NodeDef] = [
+        n
+        for n in graph.nodes
+        if n.op in _PLACEHOLDER_OPS
+        and n.name in reachable
+        and not (n.op == "PlaceholderWithDefault" and n.inputs)
+    ]
+
+    input_names = [p.name for p in placeholders]
+    if not input_names:
+        raise GraphImportError(
+            "GraphDef has no Placeholder nodes; programs need at least one "
+            "column-fed input"
+        )
+    feed = dict(inputs or {})
+    for k in feed:
+        if k not in input_names:
+            raise GraphImportError(
+                f"inputs maps unknown placeholder {k!r}; placeholders: "
+                f"{input_names}"
+            )
+
+    # topological order of the reachable subgraph, computed ONCE at import
+    # (iterative — Inception/VGG-class frozen graphs exceed Python's
+    # recursion limit; cycles are detected here, not at call time)
+    order: List[str] = []
+    state: Dict[str, int] = {}  # 0=visiting, 1=done
+    work: List[Tuple[str, bool]] = [
+        (name, False) for _, name, _ in reversed(fetch_list)
+    ]
+    while work:
+        name, processed = work.pop()
+        if processed:
+            state[name] = 1
+            order.append(name)
+            continue
+        st = state.get(name)
+        if st == 1:
+            continue
+        if st == 0:
+            raise GraphImportError(f"cycle in GraphDef at node {name!r}")
+        node = nodes.get(name)
+        if node is None:
+            raise GraphImportError(f"node {name!r} referenced but not defined")
+        state[name] = 0
+        work.append((name, True))
+        for ref in node.inputs:
+            rn, _ = _split_ref(ref)
+            if state.get(rn) == 0:
+                raise GraphImportError(f"cycle in GraphDef at node {rn!r}")
+            if state.get(rn) != 1:
+                work.append((rn, False))
+
+    def _pick(name: str, v: Any, idx: int) -> Any:
+        if idx == -1:  # control dependency: ordering only, no value
+            return None
+        if isinstance(v, tuple):
+            if idx >= len(v):
+                raise GraphImportError(
+                    f"node {name!r} has {len(v)} outputs, requested :{idx}"
+                )
+            return v[idx]
+        if idx != 0:
+            raise GraphImportError(
+                f"node {name!r} is single-output, requested :{idx}"
+            )
+        return v
+
+    def fn(**feeds):
+        cache: Dict[str, Any] = dict(feeds)
+        for name in order:
+            if name in cache:
+                continue
+            node = nodes[name]
+            if node.op == "Const":
+                av = node.attrs.get("value")
+                if av is None or not isinstance(av.value, TensorProto):
+                    raise GraphImportError(
+                        f"Const node {name!r} has no tensor value"
+                    )
+                cache[name] = av.value.value  # host numpy — const folding
+                continue
+            if node.op in _PLACEHOLDER_OPS:
+                if node.op == "PlaceholderWithDefault" and node.inputs:
+                    dn, di = _split_ref(node.inputs[0])
+                    cache[name] = _pick(dn, cache[dn], di)
+                    continue
+                raise GraphImportError(
+                    f"placeholder {name!r} was not fed; feeds: "
+                    f"{sorted(feeds)}"
+                )
+            impl = op_registry.REGISTRY.get(node.op)
+            if impl is None:
+                raise op_registry.UnsupportedOpError(
+                    f"GraphDef op {node.op!r} (node {name!r}) has no JAX "
+                    f"lowering; supported ops: {sorted(op_registry.REGISTRY)}"
+                )
+            ins = []
+            for ref in node.inputs:
+                rn, ri = _split_ref(ref)
+                v = _pick(rn, cache[rn], ri)
+                if ri != -1:
+                    ins.append(v)
+            cache[name] = impl(ins, node.attrs)
+        return {
+            out: _pick(name, cache[name], idx) for out, name, idx in fetch_list
+        }
+
+    return Program(
+        fn,
+        input_names,
+        fetches=[out for out, _, _ in fetch_list],
+        feed_dict=feed,
+    )
+
+
+def placeholder_specs(
+    graph: Union[GraphDef, bytes, str, os.PathLike]
+) -> Dict[str, Tuple[Optional[dt.ScalarType], Optional[Shape]]]:
+    """Declared dtype/shape of each placeholder — the ``GraphNodeSummary``
+    input half (``TensorFlowOps.scala:163-169``) read from attrs."""
+    if not isinstance(graph, GraphDef):
+        graph = load_graphdef(graph)
+    out = {}
+    for n in graph.nodes:
+        if n.op in _PLACEHOLDER_OPS:
+            ten = n.attrs.get("dtype")
+            st = (
+                dt.from_tf_enum(ten.value)
+                if ten is not None and ten.kind == "type"
+                else None
+            )
+            shp = n.attrs.get("shape")
+            shape = shp.value if shp is not None and shp.kind == "shape" else None
+            out[n.name] = (st, shape)
+    return out

@@ -1,0 +1,329 @@
+"""TF op -> JAX lowering registry for GraphDef import.
+
+Covers the op vocabulary of the reference's workloads: the DSL-emitted ops
+(``dsl/DslImpl.scala`` emits Placeholder/Const/Identity/Add/Div/Sum/Min with
+``reduction_indices``), the test graphs (``graph.pb``/``graph2.pb``: Const +
+Placeholder + Add), and the frozen-model scoring vocabulary
+(``read_image.py``'s VGG/Inception class of graphs: Conv2D, pooling, batch
+norm, activations, dense layers) plus the K-Means demo's
+``unsorted_segment_sum``/``argmin`` pre-aggregation kernel
+(``kmeans_demo.py:101-168``).
+
+Each entry maps ``(inputs, attrs) -> jax value(s)``; multi-output ops return
+tuples and consumers address them as ``node:k``.  Reduction/shape operands
+that TF passes as const *inputs* (reduction_indices, shape, paddings, axis)
+must be compile-time constants — the importer resolves them via constant
+folding before lowering (XLA needs static shapes; SURVEY.md §7 hard part 1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import dtypes as dt
+
+
+class UnsupportedOpError(NotImplementedError):
+    """A GraphDef node's op has no JAX lowering registered."""
+
+
+def _attr(attrs, name, default=None):
+    av = attrs.get(name)
+    return default if av is None or av.kind == "none" else av.value
+
+
+def _static(x, what: str) -> np.ndarray:
+    """Require a compile-time constant operand (e.g. reshape target)."""
+    if isinstance(x, np.ndarray):
+        return x
+    if isinstance(x, (int, float, list, tuple)):
+        return np.asarray(x)
+    raise UnsupportedOpError(
+        f"{what} must be a compile-time constant in the imported graph "
+        f"(got a traced value); freeze it into the GraphDef"
+    )
+
+
+def _np_dtype(attrs, key="T", default=np.float32):
+    en = _attr(attrs, key)
+    return dt.from_tf_enum(en).np_dtype if en is not None else default
+
+
+def _axes(v) -> Optional[Tuple[int, ...]]:
+    a = np.asarray(v).reshape(-1)
+    return tuple(int(x) for x in a)
+
+
+def _padding_str(attrs) -> str:
+    p = _attr(attrs, "padding", b"VALID")
+    return p.decode() if isinstance(p, bytes) else str(p)
+
+
+def _pool(x, attrs, reducer, init, avg=False):
+    ksize = [int(k) for k in _attr(attrs, "ksize")]
+    strides = [int(s) for s in _attr(attrs, "strides")]
+    padding = _padding_str(attrs)
+    fmt = _attr(attrs, "data_format", b"NHWC")
+    fmt = fmt.decode() if isinstance(fmt, bytes) else fmt
+    if fmt != "NHWC":
+        raise UnsupportedOpError(f"pooling data_format {fmt} not supported")
+    out = lax.reduce_window(
+        x, init, reducer, tuple(ksize), tuple(strides), padding
+    )
+    if avg:
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(
+            ones, 0.0, lax.add, tuple(ksize), tuple(strides), padding
+        )
+        out = out / counts
+    return out
+
+
+def _conv2d(ins, attrs):
+    x, w = ins
+    strides = [int(s) for s in _attr(attrs, "strides", [1, 1, 1, 1])]
+    dilations = [int(d) for d in _attr(attrs, "dilations", [1, 1, 1, 1])]
+    padding = _padding_str(attrs)
+    fmt = _attr(attrs, "data_format", b"NHWC")
+    fmt = fmt.decode() if isinstance(fmt, bytes) else fmt
+    if fmt != "NHWC":
+        raise UnsupportedOpError(f"Conv2D data_format {fmt} not supported")
+    return lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides[1:3],
+        padding=padding,
+        rhs_dilation=dilations[1:3],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _depthwise_conv2d(ins, attrs):
+    x, w = ins  # w: [H, W, C, M]
+    strides = [int(s) for s in _attr(attrs, "strides", [1, 1, 1, 1])]
+    padding = _padding_str(attrs)
+    h, wd, c, m = w.shape
+    # feature_group_count=C expects flat output channel index c*M + m, which
+    # is exactly the [H,W,C,M] memory order — reshape directly, NO transpose
+    w2 = jnp.reshape(w, (h, wd, 1, c * m))
+    return lax.conv_general_dilated(
+        x,
+        w2,
+        window_strides=strides[1:3],
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c,
+    )
+
+
+def _fused_batch_norm(ins, attrs):
+    x, scale, offset, mean, var = ins
+    eps = float(_attr(attrs, "epsilon", 1e-3))
+    is_training = bool(_attr(attrs, "is_training", False))
+    if is_training:
+        raise UnsupportedOpError(
+            "FusedBatchNorm with is_training=True is not supported for "
+            "frozen-graph scoring"
+        )
+    inv = lax.rsqrt(var + eps) * scale
+    y = x * inv + (offset - mean * inv)
+    return (y, mean, var, mean, var)
+
+
+def _strided_slice(ins, attrs):
+    x, begin, end, strides = ins
+    begin = _static(begin, "StridedSlice begin").tolist()
+    end = _static(end, "StridedSlice end").tolist()
+    strides = _static(strides, "StridedSlice strides").tolist()
+    begin_mask = int(_attr(attrs, "begin_mask", 0))
+    end_mask = int(_attr(attrs, "end_mask", 0))
+    ellipsis_mask = int(_attr(attrs, "ellipsis_mask", 0))
+    new_axis_mask = int(_attr(attrs, "new_axis_mask", 0))
+    shrink_mask = int(_attr(attrs, "shrink_axis_mask", 0))
+    if ellipsis_mask or new_axis_mask:
+        raise UnsupportedOpError(
+            "StridedSlice ellipsis/new_axis masks not supported"
+        )
+    idx = []
+    for i in range(len(begin)):
+        if shrink_mask & (1 << i):
+            idx.append(int(begin[i]))
+            continue
+        b = None if begin_mask & (1 << i) else int(begin[i])
+        e = None if end_mask & (1 << i) else int(end[i])
+        idx.append(slice(b, e, int(strides[i])))
+    return x[tuple(idx)]
+
+
+def _concat_v2(ins, attrs):
+    axis = int(_static(ins[-1], "ConcatV2 axis"))
+    return jnp.concatenate(ins[:-1], axis=axis)
+
+
+def _reduction(fn):
+    def go(ins, attrs):
+        x, axes = ins
+        keep = bool(_attr(attrs, "keep_dims", _attr(attrs, "keepdims", False)))
+        # TF semantics: reduction_indices=[] is the identity, so the empty
+        # tuple must reach jnp as axis=() (NOT None = reduce-all)
+        ax = _axes(_static(axes, "reduction_indices"))
+        return fn(x, axis=ax, keepdims=keep)
+
+    return go
+
+
+# op name -> (inputs, attrs) -> value | tuple of values
+REGISTRY: Dict[str, Callable[[List[Any], Dict], Any]] = {
+    # plumbing
+    "Identity": lambda ins, at: ins[0],
+    "IdentityN": lambda ins, at: tuple(ins),
+    "NoOp": lambda ins, at: (),
+    "StopGradient": lambda ins, at: ins[0],
+    "PreventGradient": lambda ins, at: ins[0],
+    "CheckNumerics": lambda ins, at: ins[0],
+    # arithmetic
+    "Add": lambda ins, at: ins[0] + ins[1],
+    "AddV2": lambda ins, at: ins[0] + ins[1],
+    "AddN": lambda ins, at: sum(ins[1:], ins[0]),
+    "Sub": lambda ins, at: ins[0] - ins[1],
+    "Mul": lambda ins, at: ins[0] * ins[1],
+    "Div": lambda ins, at: ins[0] / ins[1],
+    "RealDiv": lambda ins, at: ins[0] / ins[1],
+    "FloorDiv": lambda ins, at: jnp.floor_divide(ins[0], ins[1]),
+    "Maximum": lambda ins, at: jnp.maximum(ins[0], ins[1]),
+    "Minimum": lambda ins, at: jnp.minimum(ins[0], ins[1]),
+    "Neg": lambda ins, at: -ins[0],
+    "Abs": lambda ins, at: jnp.abs(ins[0]),
+    "Exp": lambda ins, at: jnp.exp(ins[0]),
+    "Log": lambda ins, at: jnp.log(ins[0]),
+    "Sqrt": lambda ins, at: jnp.sqrt(ins[0]),
+    "Rsqrt": lambda ins, at: lax.rsqrt(ins[0]),
+    "Square": lambda ins, at: ins[0] * ins[0],
+    "SquaredDifference": lambda ins, at: (ins[0] - ins[1]) ** 2,
+    "Pow": lambda ins, at: ins[0] ** ins[1],
+    "Tanh": lambda ins, at: jnp.tanh(ins[0]),
+    "Sigmoid": lambda ins, at: jax.nn.sigmoid(ins[0]),
+    "Relu": lambda ins, at: jax.nn.relu(ins[0]),
+    "Relu6": lambda ins, at: jnp.clip(ins[0], 0.0, 6.0),
+    "Elu": lambda ins, at: jax.nn.elu(ins[0]),
+    "Softplus": lambda ins, at: jax.nn.softplus(ins[0]),
+    "Softmax": lambda ins, at: jax.nn.softmax(ins[0], axis=-1),
+    "LogSoftmax": lambda ins, at: jax.nn.log_softmax(ins[0], axis=-1),
+    # comparison / select
+    "Equal": lambda ins, at: ins[0] == ins[1],
+    "NotEqual": lambda ins, at: ins[0] != ins[1],
+    "Less": lambda ins, at: ins[0] < ins[1],
+    "LessEqual": lambda ins, at: ins[0] <= ins[1],
+    "Greater": lambda ins, at: ins[0] > ins[1],
+    "GreaterEqual": lambda ins, at: ins[0] >= ins[1],
+    "Select": lambda ins, at: jnp.where(ins[0], ins[1], ins[2]),
+    "SelectV2": lambda ins, at: jnp.where(ins[0], ins[1], ins[2]),
+    # linear algebra
+    "MatMul": lambda ins, at: jnp.matmul(
+        ins[0].T if _attr(at, "transpose_a", False) else ins[0],
+        ins[1].T if _attr(at, "transpose_b", False) else ins[1],
+    ),
+    "BatchMatMul": lambda ins, at: jnp.matmul(ins[0], ins[1]),
+    "BatchMatMulV2": lambda ins, at: jnp.matmul(ins[0], ins[1]),
+    "BiasAdd": lambda ins, at: ins[0] + ins[1],
+    "Conv2D": _conv2d,
+    "DepthwiseConv2dNative": _depthwise_conv2d,
+    "MaxPool": lambda ins, at: _pool(ins[0], at, lax.max, -jnp.inf),
+    "AvgPool": lambda ins, at: _pool(ins[0], at, lax.add, 0.0, avg=True),
+    "FusedBatchNorm": _fused_batch_norm,
+    "FusedBatchNormV2": _fused_batch_norm,
+    "FusedBatchNormV3": _fused_batch_norm,
+    # reductions (reduction indices arrive as const inputs)
+    "Sum": _reduction(jnp.sum),
+    "Mean": _reduction(jnp.mean),
+    "Min": _reduction(jnp.min),
+    "Max": _reduction(jnp.max),
+    "Prod": _reduction(jnp.prod),
+    "All": _reduction(jnp.all),
+    "Any": _reduction(jnp.any),
+    "ArgMax": lambda ins, at: jnp.argmax(
+        ins[0], axis=int(_static(ins[1], "ArgMax axis"))
+    ).astype(_np_dtype(at, "output_type", np.int64)),
+    "ArgMin": lambda ins, at: jnp.argmin(
+        ins[0], axis=int(_static(ins[1], "ArgMin axis"))
+    ).astype(_np_dtype(at, "output_type", np.int64)),
+    "UnsortedSegmentSum": lambda ins, at: jax.ops.segment_sum(
+        ins[0],
+        ins[1],
+        num_segments=int(_static(ins[2], "UnsortedSegmentSum num_segments")),
+    ),
+    # shape ops (shape operands must be consts — _static enforces it)
+    "Reshape": lambda ins, at: jnp.reshape(
+        ins[0], [int(d) for d in _static(ins[1], "Reshape shape")]
+    ),
+    "Squeeze": lambda ins, at: jnp.squeeze(
+        ins[0],
+        axis=tuple(int(d) for d in _attr(at, "squeeze_dims", []) or [])
+        or None,
+    ),
+    "ExpandDims": lambda ins, at: jnp.expand_dims(
+        ins[0], int(_static(ins[1], "ExpandDims axis"))
+    ),
+    "Transpose": lambda ins, at: jnp.transpose(
+        ins[0], _axes(_static(ins[1], "Transpose perm"))
+    ),
+    "ConcatV2": _concat_v2,
+    "Concat": lambda ins, at: jnp.concatenate(
+        ins[1:], axis=int(_static(ins[0], "Concat axis"))
+    ),
+    "Pack": lambda ins, at: jnp.stack(ins, axis=int(_attr(at, "axis", 0))),
+    "Unpack": lambda ins, at: tuple(
+        jnp.moveaxis(ins[0], int(_attr(at, "axis", 0)), 0)
+    ),
+    "StridedSlice": _strided_slice,
+    "Slice": lambda ins, at: lax.dynamic_slice(
+        ins[0],
+        [int(b) for b in _static(ins[1], "Slice begin")],
+        [
+            int(s) if s != -1 else ins[0].shape[i] - int(b)
+            for i, (b, s) in enumerate(
+                zip(
+                    _static(ins[1], "Slice begin"),
+                    _static(ins[2], "Slice size"),
+                )
+            )
+        ],
+    ),
+    "Pad": lambda ins, at: jnp.pad(
+        ins[0],
+        [(int(a), int(b)) for a, b in _static(ins[1], "Pad paddings")],
+    ),
+    "PadV2": lambda ins, at: jnp.pad(
+        ins[0],
+        [(int(a), int(b)) for a, b in _static(ins[1], "Pad paddings")],
+        constant_values=ins[2],
+    ),
+    "Shape": lambda ins, at: np.asarray(ins[0].shape, dtype=np.int32),
+    "Rank": lambda ins, at: np.asarray(len(ins[0].shape), dtype=np.int32),
+    "Size": lambda ins, at: np.asarray(ins[0].size, dtype=np.int32),
+    "Fill": lambda ins, at: jnp.full(
+        [int(d) for d in _static(ins[0], "Fill dims")], ins[1]
+    ),
+    "ZerosLike": lambda ins, at: jnp.zeros_like(ins[0]),
+    "OnesLike": lambda ins, at: jnp.ones_like(ins[0]),
+    "Tile": lambda ins, at: jnp.tile(
+        ins[0], [int(m) for m in _static(ins[1], "Tile multiples")]
+    ),
+    "GatherV2": lambda ins, at: jnp.take(
+        ins[0], ins[1], axis=int(_static(ins[2], "GatherV2 axis"))
+    ),
+    "Gather": lambda ins, at: jnp.take(ins[0], ins[1], axis=0),
+    "Cast": lambda ins, at: jnp.asarray(ins[0]).astype(
+        _np_dtype(at, "DstT")
+    ),
+    "Range": lambda ins, at: np.arange(
+        int(_static(ins[0], "Range start")),
+        int(_static(ins[1], "Range limit")),
+        int(_static(ins[2], "Range delta")),
+    ),
+}

@@ -101,10 +101,41 @@ class Program:
         feed_dict: Optional[Mapping[str, str]] = None,
     ) -> "Program":
         if isinstance(fn_or_program, Program):
+            if fetches is not None and sorted(fetches) != sorted(
+                fn_or_program._declared_fetches or []
+            ):
+                raise ProgramError(
+                    "cannot re-declare fetches on an existing Program; pass "
+                    "fetches when the program is created/imported"
+                )
+            if feed_dict:
+                return fn_or_program.with_feed(feed_dict)
             return fn_or_program
+        # DSL nodes (and sequences of them) lower to a Program
+        is_node = hasattr(fn_or_program, "to_program")
+        is_node_seq = (
+            isinstance(fn_or_program, (list, tuple))
+            and fn_or_program
+            and all(hasattr(x, "to_program") for x in fn_or_program)
+        )
+        if is_node or is_node_seq:
+            from . import dsl  # local import: dsl depends on this module
+
+            nodes = [fn_or_program] if is_node else list(fn_or_program)
+            p = dsl.build_program(nodes, feed_dict=feed_dict)
+            if fetches is not None and sorted(fetches) != sorted(
+                p._declared_fetches or []
+            ):
+                raise ProgramError(
+                    f"fetches {sorted(fetches)} do not match the DSL fetch "
+                    f"node names {sorted(p._declared_fetches or [])}; name "
+                    f"fetch nodes with .named(...) instead"
+                )
+            return p
         if not callable(fn_or_program):
             raise ProgramError(
-                f"expected a callable or Program, got {type(fn_or_program).__name__}"
+                f"expected a callable, Program, or DSL node(s), got "
+                f"{type(fn_or_program).__name__}"
             )
         sig = inspect.signature(fn_or_program)
         names = []
@@ -125,6 +156,14 @@ class Program:
         if not names:
             raise ProgramError("a program needs at least one named input")
         return Program(fn_or_program, names, fetches, feed_dict)
+
+    def with_feed(self, feed_dict: Mapping[str, str]) -> "Program":
+        """A copy with additional input->column renames merged in."""
+        merged = dict(self._feed)
+        merged.update(feed_dict)
+        return Program(
+            self._fn, self._input_names, self._declared_fetches, merged
+        )
 
     # -- accessors -----------------------------------------------------------
 

@@ -1,0 +1,170 @@
+"""DSL tests — mirrors dsl/BasicSuite.scala, DSLOperationsSuite.scala and the
+Scala-DSL paths of BasicOperationsSuite (df.mapBlocks(out), reduce verbs with
+Node args)."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dsl
+
+
+def frame(data, blocks=1):
+    return tfs.analyze(tfs.TensorFrame.from_arrays(data, num_blocks=blocks))
+
+
+def test_block_placeholder_add_constant():
+    # the README Scala walkthrough: val out = a + 3.0 named "out"
+    tf = frame({"a": np.arange(5.0)})
+    a = tfs.block(tf, "a")
+    out = (a + 3.0).named("out")
+    res = tfs.map_blocks(out, tf)
+    np.testing.assert_allclose(res.column("out").data, np.arange(5.0) + 3.0)
+    assert res.column_names == ["out", "a"]
+
+
+def test_operator_sugar_and_multi_fetch():
+    tf = frame({"x": np.arange(4.0) + 1.0})
+    x = tfs.block(tf, "x")
+    res = tfs.map_blocks(
+        [(x * 2.0).named("d"), (1.0 + x).named("p"), (x / 2.0).named("h")],
+        tf,
+    )
+    np.testing.assert_allclose(res.column("d").data, (np.arange(4.0) + 1) * 2)
+    np.testing.assert_allclose(res.column("p").data, np.arange(4.0) + 2)
+    np.testing.assert_allclose(res.column("h").data, (np.arange(4.0) + 1) / 2)
+
+
+def test_row_placeholder_map_rows():
+    v = np.arange(12.0).reshape(4, 3)
+    tf = frame({"v": v})
+    r = tfs.row(tf, "v")
+    out = dsl.reduce_sum(r).named("s")
+    res = tfs.map_rows(out, tf)
+    np.testing.assert_allclose(res.column("s").data, v.sum(axis=1))
+
+
+def test_reduce_rows_with_dsl_nodes():
+    # DSLOperationsSuite-style: reduce via placeholders named x_1/x_2
+    tf = frame({"x": np.arange(10.0)})
+    x1 = dsl.placeholder("float64", (), name="x_1")
+    x2 = dsl.placeholder("float64", (), name="x_2")
+    out = dsl.add(x1, x2).named("x")
+    got = tfs.reduce_rows(out, tf)
+    assert got["x"] == pytest.approx(45.0)
+
+
+def test_reduce_blocks_with_dsl_nodes():
+    tf = frame({"x": np.arange(10.0)}, blocks=3)
+    xi = dsl.placeholder("float64", (-1,), name="x_input")
+    out = dsl.reduce_sum(xi).named("x")
+    got = tfs.reduce_blocks(out, tf)
+    assert got["x"] == pytest.approx(45.0)
+
+
+def test_constants_zeros_ones_fill():
+    tf = frame({"x": np.arange(3.0)})
+    x = tfs.block(tf, "x")
+    c = dsl.constant(np.array([10.0, 20.0, 30.0]))
+    res = tfs.map_blocks(dsl.add(x, c).named("z"), tf)
+    np.testing.assert_allclose(res.column("z").data, [10.0, 21.0, 32.0])
+    o = dsl.ones((3,))
+    res2 = tfs.map_blocks((x + o).named("z"), tf)
+    np.testing.assert_allclose(res2.column("z").data, np.arange(3.0) + 1)
+    f = dsl.fill((3,), 7.0)
+    res3 = tfs.map_blocks((x + f).named("z"), tf)
+    np.testing.assert_allclose(res3.column("z").data, np.arange(3.0) + 7)
+
+
+def test_identity_and_matmul():
+    m = np.arange(6.0).reshape(2, 3)
+    tf = frame({"m": m})
+    node = tfs.block(tf, "m")
+    res = tfs.map_blocks(dsl.identity(node).named("i"), tf)
+    np.testing.assert_allclose(res.column("i").data, m)
+    w = dsl.constant(np.ones((3, 2)))
+    res2 = tfs.map_blocks(dsl.matmul(node, w).named("y"), tf)
+    np.testing.assert_allclose(res2.column("y").data, m @ np.ones((3, 2)))
+
+
+def test_reduce_min_max_mean_ops():
+    v = np.array([[3.0, 1.0], [2.0, 5.0]])
+    tf = frame({"v": v})
+    n = tfs.block(tf, "v")
+    res = tfs.map_blocks_trimmed(
+        [
+            dsl.reduce_min(n, axis=(0,)).named("mn"),
+            dsl.reduce_max(n, axis=(0,)).named("mx"),
+            dsl.reduce_mean(n, axis=(0,)).named("av"),
+        ],
+        tf,
+    )
+    np.testing.assert_allclose(res.column("mn").data, [2.0, 1.0])
+    np.testing.assert_allclose(res.column("mx").data, [3.0, 5.0])
+    np.testing.assert_allclose(res.column("av").data, [2.5, 3.0])
+
+
+def test_right_operand_sugar():
+    # regression: scalar-on-the-left sub/div must work like add/mul
+    tf = frame({"x": np.arange(1.0, 4.0)})
+    x = tfs.block(tf, "x")
+    res = tfs.map_blocks(
+        [(10.0 - x).named("s"), (6.0 / x).named("d")], tf
+    )
+    np.testing.assert_allclose(res.column("s").data, 10.0 - np.arange(1.0, 4.0))
+    np.testing.assert_allclose(res.column("d").data, 6.0 / np.arange(1.0, 4.0))
+
+
+def test_feed_dict_with_single_node_and_user_precedence():
+    # regression: feed_dict on a bare node is honored; explicit user feed
+    # overrides block() auto-binding
+    tf = frame({"colA": np.arange(3.0), "colB": np.arange(3.0) * 10})
+    ph = dsl.placeholder("float64", (-1,), name="x")
+    out = tfs.map_blocks((ph + 1.0).named("z"), tf, feed_dict={"x": "colA"})
+    np.testing.assert_allclose(out.column("z").data, np.arange(3.0) + 1)
+    n = tfs.block(tf, "colA", name="x")
+    p = dsl.build_program([(n * 1.0).named("z")], feed_dict={"x": "colB"})
+    out2 = tfs.map_blocks(p, tf)
+    np.testing.assert_allclose(out2.column("z").data, np.arange(3.0) * 10)
+
+
+def test_unnamed_fetch_error():
+    tf = frame({"x": np.arange(3.0)})
+    x = tfs.block(tf, "x")
+    with pytest.raises(dsl.DslError, match="named"):
+        tfs.map_blocks(x + 1.0, tf)
+
+
+def test_duplicate_name_error():
+    tf = frame({"x": np.arange(3.0)})
+    x = tfs.block(tf, "x")
+    a = (x + 1.0).named("z")
+    b = (x * 2.0).named("z")
+    with pytest.raises(dsl.DslError, match="duplicate"):
+        tfs.map_blocks([a, b], tf)
+
+
+def test_no_placeholder_error():
+    with pytest.raises(dsl.DslError, match="placeholder"):
+        dsl.build_program([dsl.constant(1.0).named("c")])
+
+
+def test_deterministic_interior_names():
+    tf = frame({"x": np.arange(3.0)})
+    x = tfs.block(tf, "x")
+    out = ((x + 1.0) * 2.0).named("z")
+    p = dsl.build_program([out])
+    assert p.input_names == ["x"]
+    res = tfs.map_blocks(p, tf)
+    np.testing.assert_allclose(res.column("z").data, (np.arange(3.0) + 1) * 2)
+
+
+def test_dsl_on_mesh():
+    from tensorframes_tpu.parallel import MeshExecutor, data_mesh
+
+    tf = frame({"x": np.arange(64.0)})
+    x = tfs.block(tf, "x")
+    res = tfs.map_blocks(
+        (x * 3.0).named("z"), tf, engine=MeshExecutor(data_mesh(8))
+    )
+    np.testing.assert_allclose(res.column("z").data, np.arange(64.0) * 3)

@@ -1,0 +1,300 @@
+"""GraphDef import tests: wire codec round-trip, op lowering, and the
+frozen-model verb flows (the reference's graph.pb / read_image.py paths)."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.graphdef import (
+    GraphDef,
+    import_graphdef,
+    load_graphdef,
+    parse_graphdef,
+)
+from tensorframes_tpu.graphdef.builder import GraphBuilder
+from tensorframes_tpu.graphdef.importer import GraphImportError, placeholder_specs
+from tensorframes_tpu.graphdef.ops import UnsupportedOpError
+from tensorframes_tpu.graphdef.proto import TensorProto
+
+
+def frame(data, blocks=1):
+    return tfs.analyze(tfs.TensorFrame.from_arrays(data, num_blocks=blocks))
+
+
+# ----------------------------------------------------------- wire codec --
+
+
+def test_roundtrip_simple_graph():
+    b = GraphBuilder()
+    b.placeholder("x", "float32", [-1])
+    b.const("c", np.float32(3.0))
+    b.op("Add", "z", ["x", "c"])
+    data = b.to_bytes()
+    g = parse_graphdef(data)
+    assert [n.name for n in g.nodes] == ["x", "c", "z"]
+    assert g.nodes[2].op == "Add"
+    assert g.nodes[2].inputs == ["x", "c"]
+    # re-encode is byte-stable
+    assert g.encode() == parse_graphdef(g.encode()).encode()
+
+
+def test_tensorproto_roundtrip_dtypes():
+    for arr in [
+        np.arange(6, dtype=np.float32).reshape(2, 3),
+        np.arange(4, dtype=np.float64),
+        np.array([1, -2, 3], dtype=np.int32),
+        np.array([2**40, -(2**41)], dtype=np.int64),
+        np.array([True, False]),
+    ]:
+        tp = TensorProto.from_numpy(arr)
+        back = TensorProto.parse(tp.encode())
+        np.testing.assert_array_equal(back.value, arr)
+        assert back.value.dtype == arr.dtype
+
+
+def test_tensorproto_scalar_broadcast():
+    # proto convention: single value + shape = fill
+    tp = TensorProto.from_numpy(np.float32(2.5))
+    import tensorframes_tpu.graphdef.proto as proto
+    import tensorframes_tpu.graphdef.wire as wire
+
+    out = bytearray()
+    wire.write_varint_field(out, 1, tp.dtype)
+    wire.write_len_field(out, 2, proto.encode_shape(tfs.Shape((2, 2))))
+    import struct
+
+    wire.write_fixed32_field(out, 5, struct.pack("<f", 2.5))
+    back = TensorProto.parse(bytes(out))
+    np.testing.assert_array_equal(back.value, np.full((2, 2), 2.5, np.float32))
+
+
+def test_string_tensor():
+    arr = np.empty(2, dtype=object)
+    arr[0], arr[1] = b"ab", b"cde"
+    tp = TensorProto.from_numpy(arr)
+    back = TensorProto.parse(tp.encode())
+    assert list(back.value) == [b"ab", b"cde"]
+
+
+# ------------------------------------------------------------- importer --
+
+
+def test_import_add_graph_map_blocks():
+    # the reference README flow: frozen graph z = x + 3 run via map_blocks
+    b = GraphBuilder()
+    b.placeholder("x", "float64", [-1])
+    b.const("three", np.float64(3.0))
+    b.op("Add", "z", ["x", "three"])
+    p = import_graphdef(b.build(), fetches=["z"])
+    tf = frame({"x": np.arange(10.0)})
+    out = tfs.map_blocks(p, tf)
+    np.testing.assert_allclose(out.column("z").data, np.arange(10.0) + 3.0)
+
+
+def test_import_fetch_colon_zero_and_inputs_mapping():
+    b = GraphBuilder()
+    b.placeholder("in", "float64", [-1])
+    b.const("two", np.float64(2.0))
+    b.op("Mul", "y", ["in", "two"])
+    p = import_graphdef(b.build(), fetches=["y:0"], inputs={"in": "x"})
+    tf = frame({"x": np.arange(4.0)})
+    out = tfs.map_blocks(p, tf)
+    np.testing.assert_allclose(out.column("y").data, np.arange(4.0) * 2)
+
+
+def test_import_mlp_map_rows():
+    # benchmark config #3 shape: per-row MLP inference from a frozen graph
+    rng = np.random.RandomState(0)
+    w1, b1 = rng.randn(8, 16).astype(np.float32), rng.randn(16).astype(np.float32)
+    w2, b2 = rng.randn(16, 4).astype(np.float32), rng.randn(4).astype(np.float32)
+    g = GraphBuilder()
+    g.placeholder("v", "float32", [-1, 8])
+    g.const("w1", w1)
+    g.const("b1", b1)
+    g.const("w2", w2)
+    g.const("b2", b2)
+    g.op("MatMul", "h0", ["v", "w1"])
+    g.op("BiasAdd", "h1", ["h0", "b1"])
+    g.op("Relu", "h", ["h1"])
+    g.op("MatMul", "l0", ["h", "w2"])
+    g.op("BiasAdd", "logits", ["l0", "b2"])
+    g.op("Softmax", "probs", ["logits"])
+    p = import_graphdef(g.build(), fetches=["probs"])
+    x = rng.randn(32, 8).astype(np.float32)
+    tf = frame({"v": x})
+    out = tfs.map_blocks(p, tf)
+    h = np.maximum(x @ w1 + b1, 0)
+    logits = h @ w2 + b2
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(
+        out.column("probs").data, e / e.sum(axis=1, keepdims=True), rtol=1e-5
+    )
+
+
+def test_import_reduction_with_const_indices():
+    # DSL-emitted reducer shape: Sum with reduction_indices const input
+    b = GraphBuilder()
+    b.placeholder("x_input", "float64", [-1])
+    b.const("idx", np.array([0], dtype=np.int32))
+    b.op("Sum", "x", ["x_input", "idx"], keep_dims=False)
+    p = import_graphdef(b.build(), fetches=["x"])
+    tf = frame({"x": np.arange(10.0)}, blocks=3)
+    got = tfs.reduce_blocks(p, tf)
+    assert got["x"] == pytest.approx(45.0)
+
+
+def test_import_conv_pool_graph():
+    rng = np.random.RandomState(0)
+    img = rng.randn(2, 8, 8, 3).astype(np.float32)
+    w = rng.randn(3, 3, 3, 4).astype(np.float32)
+    g = GraphBuilder()
+    g.placeholder("img", "float32", [-1, 8, 8, 3])
+    g.const("w", w)
+    g.op(
+        "Conv2D", "conv", ["img", "w"],
+        strides=[1, 1, 1, 1], padding=b"SAME",
+    )
+    g.op("Relu", "act", ["conv"])
+    g.op(
+        "MaxPool", "pool", ["act"],
+        ksize=[1, 2, 2, 1], strides=[1, 2, 2, 1], padding=b"VALID",
+    )
+    p = import_graphdef(g.build(), fetches=["pool"])
+    tf = frame({"img": img})
+    out = tfs.map_blocks(p, tf)
+    assert out.column("pool").data.shape == (2, 4, 4, 4)
+    # oracle via jax directly
+    import jax.numpy as jnp
+    from jax import lax
+
+    conv = lax.conv_general_dilated(
+        img, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    act = np.maximum(np.asarray(conv), 0)
+    pool = np.asarray(
+        lax.reduce_window(act, -np.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    )
+    np.testing.assert_allclose(out.column("pool").data, pool, rtol=1e-5)
+
+
+def test_import_segment_sum_preagg():
+    # the kmeans_demo.py:101-168 pre-aggregation kernel pattern
+    b = GraphBuilder()
+    b.placeholder("x", "float64", [-1])
+    b.placeholder("seg", "int32", [-1])
+    b.const("k", np.int32(3))
+    b.op("UnsortedSegmentSum", "sums", ["x", "seg", "k"])
+    p = import_graphdef(b.build(), fetches=["sums"])
+    tf = frame(
+        {
+            "x": np.array([1.0, 2.0, 3.0, 4.0]),
+            "seg": np.array([0, 2, 0, 1], dtype=np.int32),
+        }
+    )
+    out = tfs.map_blocks_trimmed(p, tf)
+    np.testing.assert_allclose(out.column("sums").data, [4.0, 4.0, 2.0])
+
+
+def test_depthwise_conv_multiplier_gt_one():
+    # regression: kernel [H,W,C,M] must reshape WITHOUT transpose so output
+    # channel c*M+m gets x[...,c] * w[...,c,m] (TF depthwise semantics)
+    from tensorframes_tpu.graphdef.ops import REGISTRY
+
+    x = np.array([[[[1.0, 10.0]]]], np.float32)
+    w = np.array([[[[1.0, 2.0], [3.0, 4.0]]]], np.float32)
+    out = np.asarray(
+        REGISTRY["DepthwiseConv2dNative"]([x, w], {})
+    ).ravel()
+    np.testing.assert_allclose(out, [1.0, 2.0, 30.0, 40.0])
+
+
+def test_empty_reduction_indices_is_identity():
+    # regression: TF Sum with reduction_indices=[] is the identity
+    from tensorframes_tpu.graphdef.ops import REGISTRY
+
+    r = REGISTRY["Sum"](
+        [np.ones((2, 3), np.float32), np.array([], np.int32)], {}
+    )
+    assert np.asarray(r).shape == (2, 3)
+
+
+def test_deep_graph_no_recursion_limit():
+    # regression: Inception-scale op chains must not hit Python recursion
+    b = GraphBuilder()
+    b.placeholder("x", "float64", [-1])
+    prev = "x"
+    for i in range(600):
+        prev = b.op("Identity", f"n{i}", [prev])
+    p = import_graphdef(b.build(), fetches=[prev])
+    out = tfs.map_blocks(p, frame({"x": np.arange(3.0)}))
+    np.testing.assert_allclose(out.column(prev).data, np.arange(3.0))
+
+
+def test_cycle_detected_at_import():
+    b = GraphBuilder()
+    b.placeholder("p", "float64", [-1])
+    b.op("Add", "a", ["p", "b"])
+    b.op("Add", "b", ["a", "p"])
+    with pytest.raises(GraphImportError, match="cycle"):
+        import_graphdef(b.build(), fetches=["a"])
+
+
+def test_feed_dict_on_imported_program():
+    # regression: feed_dict passed at verb level must apply to Programs
+    b = GraphBuilder()
+    b.placeholder("p", "float64", [-1])
+    b.const("c", np.float64(1.0))
+    b.op("Add", "z", ["p", "c"])
+    p = import_graphdef(b.build(), fetches=["z"])
+    out = tfs.map_blocks(p, frame({"x": np.arange(3.0)}), feed_dict={"p": "x"})
+    np.testing.assert_allclose(out.column("z").data, np.arange(3.0) + 1)
+
+
+def test_placeholder_pruning():
+    b = GraphBuilder()
+    b.placeholder("used", "float64", [-1])
+    b.placeholder("unused", "float64", [-1])
+    b.const("c", np.float64(1.0))
+    b.op("Add", "z", ["used", "c"])
+    p = import_graphdef(b.build(), fetches=["z"])
+    assert p.input_names == ["used"]
+
+
+def test_import_errors():
+    b = GraphBuilder()
+    b.placeholder("x", "float64", [-1])
+    b.op("Identity", "y", ["x"])
+    g = b.build()
+    with pytest.raises(GraphImportError, match="not found"):
+        import_graphdef(g, fetches=["nope"])
+    with pytest.raises(GraphImportError, match="unknown placeholder"):
+        import_graphdef(g, fetches=["y"], inputs={"bogus": "x"})
+    b2 = GraphBuilder()
+    b2.placeholder("x", "float64", [-1])
+    b2.op("SomeExoticOp", "y", ["x"])
+    p2 = import_graphdef(b2.build(), fetches=["y"])
+    with pytest.raises(UnsupportedOpError, match="SomeExoticOp"):
+        tfs.map_blocks(p2, frame({"x": np.arange(3.0)}))
+
+
+def test_placeholder_specs():
+    b = GraphBuilder()
+    b.placeholder("x", "float32", [-1, 3])
+    specs = placeholder_specs(b.build())
+    st, shape = specs["x"]
+    assert st.name == "float32"
+    assert shape == (tfs.UNKNOWN, 3)
+
+
+def test_load_graphdef_from_file(tmp_path):
+    b = GraphBuilder()
+    b.placeholder("x", "float64", [-1])
+    b.const("c", np.float64(5.0))
+    b.op("Add", "z", ["x", "c"])
+    path = tmp_path / "g.pb"
+    path.write_bytes(b.to_bytes())
+    g = load_graphdef(path)
+    assert isinstance(g, GraphDef)
+    p = import_graphdef(g, fetches=["z"])
+    out = tfs.map_blocks(p, frame({"x": np.arange(3.0)}))
+    np.testing.assert_allclose(out.column("z").data, np.arange(3.0) + 5)

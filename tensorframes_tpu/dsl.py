@@ -250,19 +250,24 @@ _EVAL = {
 def _collect(fetches: Sequence[Node]) -> List[Node]:
     """Transitive closure in deterministic topological order (the reference's
     freeze + dedup, ``dsl/DslImpl.scala:38-75``)."""
+    # iterative DFS — deep op chains must not hit Python's recursion limit
+    # (same constraint as graphdef/importer.py's topo sort)
     seen: Dict[int, Node] = {}
     order: List[Node] = []
-
-    def visit(n: Node):
-        if n.id in seen:
-            return
-        seen[n.id] = n
-        for p in n.parents:
-            visit(p)
-        order.append(n)
-
     for f in fetches:
-        visit(f)
+        stack: List[Tuple[Node, int]] = [(f, 0)]
+        while stack:
+            n, pi = stack.pop()
+            if pi == 0 and n.id in seen:
+                continue
+            seen[n.id] = n
+            if pi < len(n.parents):
+                stack.append((n, pi + 1))
+                child = n.parents[pi]
+                if child.id not in seen:
+                    stack.append((child, 0))
+            else:
+                order.append(n)
     return order
 
 
@@ -283,7 +288,11 @@ def build_program(
     order = _collect(fetch_nodes)
 
     # name assignment: user names win, must be unique; anonymous fetches
-    # are an error (outputs need stable column names)
+    # are an error (outputs need stable column names).  Generated names live
+    # in a local node->name map so building a program never mutates the
+    # user's Node objects (nodes shared between programs would otherwise
+    # collide on their first generated name).
+    names: Dict[int, str] = {}
     used: Dict[str, Node] = {}
     counters: Dict[str, int] = {}
     for n in order:
@@ -293,6 +302,7 @@ def build_program(
                     f"duplicate node name {n.name!r} in DSL graph"
                 )
             used[n.name] = n
+            names[n.id] = n.name
     for f in fetch_nodes:
         if f.name is None:
             raise DslError(
@@ -308,7 +318,7 @@ def build_program(
                 i += 1
                 counters[n.op] = i + 1
                 candidate = f"{n.op}_{i}"
-            n.name = candidate
+            names[n.id] = candidate
             used[candidate] = n
 
     placeholders = [n for n in order if n.op == "placeholder"]
@@ -317,18 +327,19 @@ def build_program(
             "DSL graph has no placeholders; programs need at least one "
             "column-fed input"
         )
-    input_names = [p.name for p in placeholders]
+    input_names = [names[p.id] for p in placeholders]
     feed = dict(feed_dict or {})
     for p in placeholders:
+        pname = names[p.id]
         col = p.attrs.get("column")
         # auto column binding from block()/row(); explicit user feed wins
-        if col is not None and col != p.name and p.name not in feed:
-            feed[p.name] = col
+        if col is not None and col != pname and pname not in feed:
+            feed[pname] = col
 
     def fn(**inputs):
         cache: Dict[int, Any] = {}
         for p in placeholders:
-            cache[p.id] = inputs[p.name]
+            cache[p.id] = inputs[names[p.id]]
         for n in order:
             if n.id in cache:
                 continue

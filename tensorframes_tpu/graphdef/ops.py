@@ -59,17 +59,20 @@ def _axes(v) -> Optional[Tuple[int, ...]]:
     return tuple(int(x) for x in a)
 
 
+def _str_attr(attrs, name: str, default: bytes) -> str:
+    v = _attr(attrs, name, default)
+    return v.decode() if isinstance(v, bytes) else str(v)
+
+
 def _padding_str(attrs) -> str:
-    p = _attr(attrs, "padding", b"VALID")
-    return p.decode() if isinstance(p, bytes) else str(p)
+    return _str_attr(attrs, "padding", b"VALID")
 
 
 def _pool(x, attrs, reducer, init, avg=False):
     ksize = [int(k) for k in _attr(attrs, "ksize")]
     strides = [int(s) for s in _attr(attrs, "strides")]
     padding = _padding_str(attrs)
-    fmt = _attr(attrs, "data_format", b"NHWC")
-    fmt = fmt.decode() if isinstance(fmt, bytes) else fmt
+    fmt = _str_attr(attrs, "data_format", b"NHWC")
     if fmt != "NHWC":
         raise UnsupportedOpError(f"pooling data_format {fmt} not supported")
     out = lax.reduce_window(
@@ -89,8 +92,7 @@ def _conv2d(ins, attrs):
     strides = [int(s) for s in _attr(attrs, "strides", [1, 1, 1, 1])]
     dilations = [int(d) for d in _attr(attrs, "dilations", [1, 1, 1, 1])]
     padding = _padding_str(attrs)
-    fmt = _attr(attrs, "data_format", b"NHWC")
-    fmt = fmt.decode() if isinstance(fmt, bytes) else fmt
+    fmt = _str_attr(attrs, "data_format", b"NHWC")
     if fmt != "NHWC":
         raise UnsupportedOpError(f"Conv2D data_format {fmt} not supported")
     return lax.conv_general_dilated(
@@ -228,8 +230,14 @@ REGISTRY: Dict[str, Callable[[List[Any], Dict], Any]] = {
         ins[0].T if _attr(at, "transpose_a", False) else ins[0],
         ins[1].T if _attr(at, "transpose_b", False) else ins[1],
     ),
-    "BatchMatMul": lambda ins, at: jnp.matmul(ins[0], ins[1]),
-    "BatchMatMulV2": lambda ins, at: jnp.matmul(ins[0], ins[1]),
+    "BatchMatMul": lambda ins, at: jnp.matmul(
+        jnp.swapaxes(ins[0], -1, -2) if _attr(at, "adj_x", False) else ins[0],
+        jnp.swapaxes(ins[1], -1, -2) if _attr(at, "adj_y", False) else ins[1],
+    ),
+    "BatchMatMulV2": lambda ins, at: jnp.matmul(
+        jnp.swapaxes(ins[0], -1, -2) if _attr(at, "adj_x", False) else ins[0],
+        jnp.swapaxes(ins[1], -1, -2) if _attr(at, "adj_y", False) else ins[1],
+    ),
     "BiasAdd": lambda ins, at: ins[0] + ins[1],
     "Conv2D": _conv2d,
     "DepthwiseConv2dNative": _depthwise_conv2d,
@@ -322,8 +330,8 @@ REGISTRY: Dict[str, Callable[[List[Any], Dict], Any]] = {
         _np_dtype(at, "DstT")
     ),
     "Range": lambda ins, at: np.arange(
-        int(_static(ins[0], "Range start")),
-        int(_static(ins[1], "Range limit")),
-        int(_static(ins[2], "Range delta")),
+        np.asarray(_static(ins[0], "Range start")).item(),
+        np.asarray(_static(ins[1], "Range limit")).item(),
+        np.asarray(_static(ins[2], "Range delta")).item(),
     ),
 }

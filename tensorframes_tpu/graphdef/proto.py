@@ -217,7 +217,17 @@ class AttrValue:
                         else:
                             items.append(struct.unpack("<f", v2)[0])
                     elif f2 == 5:
-                        items.append(bool(v2))
+                        # `repeated bool b = 5 [packed = true]` — TF writers
+                        # emit one length-delimited blob of 0/1 varints
+                        if wt2 == wire.WIRE_LEN:
+                            items.extend(
+                                bool(b)
+                                for b in wire.unpack_packed_varints(
+                                    v2, signed=False
+                                )
+                            )
+                        else:
+                            items.append(bool(v2))
                     elif f2 == 6:
                         if wt2 == wire.WIRE_LEN:
                             items.extend(

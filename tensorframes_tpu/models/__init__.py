@@ -1,0 +1,28 @@
+"""Model families executed through the verb engine.
+
+The reference ships no model code in its core — models arrive as *frozen
+graphs* whose variables were baked into constants before scoring
+(``/root/reference/src/main/python/tensorframes_snippets/read_image.py:108-118``)
+and iterative algorithms re-embed updated state into a fresh graph every step
+(``kmeans_demo.py:68-80``).  The TPU-native analog of "freeze variables into
+the graph" is a *closure*: model params are captured by the program function
+and become XLA constants (or donated device buffers) at jit time.
+
+Families here, one per BASELINE.json north-star config:
+
+* ``mlp`` — per-row MLP inference (MNIST; config #3, the
+  ``read_image.py`` frozen-model scoring pattern at row granularity);
+* ``inception_v3`` — full Inception-v3 image scoring via ``map_blocks``
+  (config #4, the flagship benchmark);
+* ``logistic_regression`` — distributed gradient-sum training via
+  ``map_blocks_trimmed`` + ``reduce_blocks`` (config #5);
+* ``kmeans`` — both aggregation strategies of the reference's K-Means demo
+  (``kmeans_demo.py:46-168``): groupBy+aggregate, and in-program
+  pre-aggregation + reduce_blocks;
+* ``transformer`` — long-context decoder with ring-attention sequence
+  parallelism (net-new for the TPU build, SURVEY.md §5 "long-context").
+"""
+
+from . import kmeans, logistic_regression, mlp
+
+__all__ = ["kmeans", "logistic_regression", "mlp"]

@@ -1,0 +1,158 @@
+"""Distributed K-Means through the verbs — both reference strategies.
+
+Re-designs ``/root/reference/src/main/python/tensorframes_snippets/kmeans_demo.py``:
+
+* strategy ``"aggregate"`` (demo L46-98): ``map_blocks`` assigns each point
+  its closest center, then ``group_by("closest").aggregate`` sums points and
+  counts per cluster (the Spark-shuffle path, here a device keyed reduction);
+* strategy ``"preagg"`` (demo L101-168, the fast path): the assignment
+  *and* the per-cluster sums happen inside ONE ``map_blocks_trimmed``
+  program via ``segment_sum`` (the demo's ``unsorted_segment_sum``), each
+  block emitting exactly ``k`` partial rows; ``reduce_blocks`` then sums the
+  partials across blocks — on a MeshExecutor that combine is an ICI psum
+  instead of Spark's driver reduce.
+
+Like the demo (L68-80), each iteration re-embeds the updated centers into a
+fresh program: the closure re-jits per iteration in exchange for centers
+being XLA constants.  Distance kernel: ||x-c||^2 = ||x||^2 - 2 x.c + ||c||^2
+with the cross term as one MXU matmul (demo L55-60 computes the same via
+squared_distance; the matmul form is the TPU-shaped variant).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame import TensorFrame
+from ..ops import aggregate, group_by, map_blocks, reduce_blocks
+from ..ops.engine import Executor
+
+
+def _closest(points: jnp.ndarray, centers: jnp.ndarray) -> jnp.ndarray:
+    """[n, d] x [k, d] -> [n] argmin of squared distance (one matmul)."""
+    cross = points @ centers.T  # MXU
+    c2 = jnp.sum(centers * centers, axis=1)
+    return jnp.argmin(c2[None, :] - 2.0 * cross, axis=1)
+
+
+def assignment_program(centers):
+    """``map_blocks``: ``points`` [n, d] -> ``closest`` [n] (demo L46-66)."""
+    c = jnp.asarray(centers)
+
+    def fn(points):
+        return {"closest": _closest(points, c).astype(jnp.int64)}
+
+    return fn
+
+
+def preagg_program(centers):
+    """``map_blocks_trimmed``: block [n, d] -> ONE partial row with cells
+    ``psum`` [k, d], ``pcount`` [k] (demo L128-148's per-block
+    ``unsorted_segment_sum``; one row per block so the later cross-block
+    ``reduce_blocks`` sum is per-cluster)."""
+    c = jnp.asarray(centers)
+    k = c.shape[0]
+
+    def fn(points):
+        idx = _closest(points, c)
+        onehot = (idx[:, None] == jnp.arange(k)[None, :]).astype(points.dtype)
+        # segment_sum as [k, n] @ [n, d] — keeps the hot op on the MXU for
+        # large n instead of scatter-adds
+        sums = onehot.T @ points
+        counts = onehot.sum(axis=0)
+        return {"psum": sums[None], "pcount": counts[None]}
+
+    return fn
+
+
+def _combine_program():
+    def fn(psum_input, pcount_input):
+        return {"psum": psum_input.sum(0), "pcount": pcount_input.sum(0)}
+
+    return fn
+
+
+def _agg_sum_program():
+    def fn(points_input, one_input):
+        return {"points": points_input.sum(0), "one": one_input.sum(0)}
+
+    return fn
+
+
+def step(
+    centers: np.ndarray,
+    frame: TensorFrame,
+    strategy: str = "preagg",
+    engine: Optional[Executor] = None,
+) -> np.ndarray:
+    """One Lloyd iteration -> new centers [k, d]."""
+    k, d = centers.shape
+    if strategy == "preagg":
+        partials = map_blocks(
+            preagg_program(centers), frame, trim=True, engine=engine
+        )
+        total = reduce_blocks(_combine_program(), partials, engine=engine)
+        sums = np.asarray(total["psum"])
+        counts = np.asarray(total["pcount"])
+    elif strategy == "aggregate":
+        assigned = map_blocks(assignment_program(centers), frame, engine=engine)
+        arrs = assigned.to_arrays()
+        witheach = TensorFrame.from_arrays(
+            {
+                "closest": arrs["closest"],
+                "points": arrs["points"],
+                "one": np.ones(len(arrs["closest"]), dtype=np.float64),
+            },
+            num_blocks=frame.num_blocks,
+        )
+        grouped = aggregate(
+            _agg_sum_program(), group_by(witheach, "closest"), engine=engine
+        )
+        out = grouped.to_arrays()
+        sums = np.zeros((k, d))
+        counts = np.zeros(k)
+        present = np.asarray(out["closest"], dtype=np.int64)
+        sums[present] = np.asarray(out["points"])
+        counts[present] = np.asarray(out["one"])
+    else:
+        raise ValueError(
+            f"unknown strategy {strategy!r}; use 'preagg' or 'aggregate'"
+        )
+    # empty clusters keep their previous center (demo keeps MLlib semantics)
+    safe = np.where(counts > 0, counts, 1.0)
+    new = sums / safe[:, None]
+    return np.where(counts[:, None] > 0, new, centers)
+
+
+def fit(
+    frame: TensorFrame,
+    k: int,
+    num_iters: int = 10,
+    strategy: str = "preagg",
+    engine: Optional[Executor] = None,
+    seed: int = 0,
+    init_centers: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Lloyd's algorithm on column ``points`` [n, d].  Returns
+    (centers [k, d], assignments [n]).  Default init is k-means++-style
+    greedy farthest-point seeding (deterministic given ``seed``)."""
+    pts = np.asarray(frame.column("points").data, dtype=np.float64)
+    if init_centers is not None:
+        centers = np.asarray(init_centers, dtype=np.float64).copy()
+    else:
+        rng = np.random.RandomState(seed)
+        chosen = [rng.randint(len(pts))]
+        for _ in range(k - 1):
+            d2 = np.min(
+                ((pts[:, None, :] - pts[chosen][None, :, :]) ** 2).sum(-1),
+                axis=1,
+            )
+            chosen.append(int(np.argmax(d2)))
+        centers = pts[chosen].copy()
+    for _ in range(num_iters):
+        centers = np.asarray(step(centers, frame, strategy, engine))
+    assigned = map_blocks(assignment_program(centers), frame, engine=engine)
+    return centers, np.asarray(assigned.to_arrays()["closest"])

@@ -1,0 +1,140 @@
+"""Logistic regression with distributed gradient-sum — BASELINE config #5.
+
+The reference pattern being re-expressed: ``tfs.aggregate`` / ``reduce_blocks``
+as a *distributed algebraic sum* of per-partition partial results
+(``/root/reference/src/main/scala/org/tensorframes/impl/DebugRowOps.scala:503-526,547-592``;
+the pre-aggregation idiom is ``kmeans_demo.py:101-168``).  A training step is:
+
+1. ``map_blocks_trimmed`` with a gradient program — each block (partition)
+   collapses to ONE row holding its gradient sum and example count
+   (the map-side pre-reduction, SURVEY.md §2.7 P3);
+2. ``reduce_blocks`` sums those partials across blocks — on a
+   ``MeshExecutor`` this lands on ICI ``psum`` instead of the reference's
+   driver-side ``RDD.reduce`` (P4);
+3. a host-side (or jitted) parameter update.
+
+The gradient program differentiates the loss *inside* the verb program via
+``jax.grad`` — the TPU-native replacement for hand-built gradient graphs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame import TensorFrame
+from ..ops import map_blocks, reduce_blocks
+from ..ops.engine import Executor
+
+
+def init(num_features: int, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+    return {
+        "w": jnp.zeros((num_features,), dtype),
+        "b": jnp.zeros((), dtype),
+    }
+
+
+def _loss(params, x, y):
+    """Mean binary cross-entropy over a block; y in {0, 1}."""
+    logits = x @ params["w"] + params["b"]
+    # numerically stable BCE-with-logits
+    per = jnp.maximum(logits, 0) - logits * y + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )
+    return per.sum()
+
+
+def grad_program(params):
+    """Block program: features [n, d] + label [n] -> one-row partials.
+
+    Outputs (all lead dim 1, so the trimmed block is a single row):
+    ``grad_w`` [1, d], ``grad_b`` [1], ``count`` [1], ``loss`` [1] —
+    summable partials, the UDAF-compatible algebraic form the reference's
+    ``aggregate`` contract requires (``Operations.scala:110-126``).
+    """
+
+    def fn(features, label):
+        g = jax.grad(_loss)(params, features, label)
+        gw, gb = g["w"], g["b"]
+        loss = _loss(params, features, label)
+        n = features.shape[0]
+        return {
+            "grad_w": gw[None, :],
+            "grad_b": gb[None],
+            "count": jnp.full((1,), n, dtype=features.dtype),
+            "loss": loss[None],
+        }
+
+    return fn
+
+
+def _sum_program():
+    def fn(grad_w_input, grad_b_input, count_input, loss_input):
+        return {
+            "grad_w": grad_w_input.sum(0),
+            "grad_b": grad_b_input.sum(0),
+            "count": count_input.sum(0),
+            "loss": loss_input.sum(0),
+        }
+
+    return fn
+
+
+def gradient_step(
+    params,
+    frame: TensorFrame,
+    lr: float,
+    engine: Optional[Executor] = None,
+) -> Tuple[Dict[str, jnp.ndarray], float]:
+    """One full distributed step: per-block grad partials -> cross-block sum
+    -> SGD update.  Returns (new_params, mean_loss)."""
+    partials = map_blocks(
+        grad_program(params), frame, trim=True, engine=engine
+    )
+    summed = reduce_blocks(_sum_program(), partials, engine=engine)
+    n = float(summed["count"])
+    gw = jnp.asarray(summed["grad_w"]) / n
+    gb = jnp.asarray(summed["grad_b"]) / n
+    new = {
+        "w": params["w"] - lr * gw.astype(params["w"].dtype),
+        "b": params["b"] - lr * gb.astype(params["b"].dtype),
+    }
+    return new, float(summed["loss"]) / n
+
+
+def fit(
+    frame: TensorFrame,
+    num_iters: int = 50,
+    lr: float = 0.5,
+    engine: Optional[Executor] = None,
+    feature_col: str = "features",
+    label_col: str = "label",
+):
+    """Train on a frame with columns ``features`` [n, d] and ``label`` [n]."""
+    if feature_col != "features" or label_col != "label":
+        frame = frame.select([feature_col, label_col])
+        # rename via schema is unnecessary: grad_program uses feed-free names,
+        # so remap by rebuilding with canonical names
+        arrs = frame.to_arrays()
+        frame = TensorFrame.from_arrays(
+            {
+                "features": arrs[feature_col],
+                "label": arrs[label_col],
+            },
+            num_blocks=frame.num_blocks,
+        )
+    d = frame.schema["features"].cell_shape[0]
+    params = init(d)
+    losses = []
+    for _ in range(num_iters):
+        params, loss = gradient_step(params, frame, lr, engine=engine)
+        losses.append(loss)
+    return params, losses
+
+
+def predict(params, features: np.ndarray) -> np.ndarray:
+    logits = features @ np.asarray(params["w"]) + float(params["b"])
+    return (logits > 0).astype(np.int32)

@@ -1,0 +1,76 @@
+"""MLP scoring/training — the per-row frozen-model inference family.
+
+BASELINE.json config #3: ``tfs.map_rows`` per-row MLP inference (MNIST).  The
+reference's pattern is a frozen GraphDef scored row-by-row with a feed_dict
+mapping graph inputs to DataFrame columns
+(``/root/reference/src/main/python/tensorframes_snippets/read_image.py:108-167``).
+Here the "frozen graph" is a params closure jitted once; ``map_rows`` vmaps it
+over every block, so per-row inference still runs as one batched MXU matmul
+per block instead of one session.run per row
+(``DebugRowOps.scala:819-857`` is the per-row session loop being replaced).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Params = List[Dict[str, jnp.ndarray]]
+
+
+def init(
+    rng: jax.Array,
+    layer_sizes: Sequence[int],
+    dtype=jnp.float32,
+) -> Params:
+    """He-initialised dense stack: ``layer_sizes = [in, h1, ..., out]``."""
+    params: Params = []
+    keys = jax.random.split(rng, len(layer_sizes) - 1)
+    for k, fan_in, fan_out in zip(keys, layer_sizes[:-1], layer_sizes[1:]):
+        w = jax.random.normal(k, (fan_in, fan_out), dtype) * jnp.sqrt(
+            2.0 / fan_in
+        ).astype(dtype)
+        params.append({"w": w, "b": jnp.zeros((fan_out,), dtype)})
+    return params
+
+
+def apply(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Forward pass -> logits.  ``x``: [..., in_features]."""
+    h = x
+    for layer in params[:-1]:
+        h = jax.nn.relu(h @ layer["w"] + layer["b"])
+    last = params[-1]
+    return h @ last["w"] + last["b"]
+
+
+def scoring_program(params: Params):
+    """Cell-level program for ``map_rows``: input ``image`` [features] ->
+    ``{"logits": [classes], "prediction": []}``.
+
+    Feed a differently-named column with ``feed_dict={"image": colname}`` —
+    the reference's frozen-graph feed contract (``read_image.py:164-167``).
+    """
+
+    def fn(image):
+        logits = apply(params, image)
+        return {
+            "logits": logits,
+            "prediction": jnp.argmax(logits, axis=-1),
+        }
+
+    return fn
+
+
+def block_scoring_program(params: Params):
+    """Block-level flavor for ``map_blocks``: ``image`` [n, features]."""
+
+    def fn(image):
+        logits = apply(params, image)
+        return {
+            "logits": logits,
+            "prediction": jnp.argmax(logits, axis=-1),
+        }
+
+    return fn

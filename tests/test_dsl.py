@@ -7,6 +7,7 @@ import pytest
 
 import tensorframes_tpu as tfs
 from tensorframes_tpu import dsl
+from tensorframes_tpu.program import Program
 
 
 def frame(data, blocks=1):
@@ -168,3 +169,33 @@ def test_dsl_on_mesh():
         (x * 3.0).named("z"), tf, engine=MeshExecutor(data_mesh(8))
     )
     np.testing.assert_allclose(res.column("z").data, np.arange(64.0) * 3)
+
+
+# --------------------------------------------------- review regressions --
+
+
+def test_deep_dsl_chain_no_recursion_limit():
+    x = dsl.placeholder("float64", [-1], name="x")
+    node = x
+    for _ in range(3000):
+        node = node + 1.0
+    p = Program.wrap(node.named("z"))
+    tf = frame({"x": np.zeros(4)})
+    out = tfs.map_blocks(p, tf)
+    np.testing.assert_allclose(out.column("z").data, np.full(4, 3000.0))
+
+
+def test_build_program_does_not_mutate_shared_nodes():
+    x = dsl.placeholder("float64", [-1], name="x")
+    a = x + 1.0  # anonymous shared node
+    b = x * 2.0  # anonymous shared node
+    p1 = Program.wrap((a + b).named("p"))
+    p2 = Program.wrap((a * b).named("q"))
+    assert a.name is None and b.name is None
+    # both subtrees still combine into a third program without name clashes
+    p3 = Program.wrap([(a + b).named("r"), (a * b).named("s")])
+    tf = frame({"x": np.arange(3.0)})
+    r = tfs.map_blocks(p3, tf).to_arrays()
+    np.testing.assert_allclose(r["r"], (np.arange(3.0) + 1) + np.arange(3.0) * 2)
+    np.testing.assert_allclose(r["s"], (np.arange(3.0) + 1) * np.arange(3.0) * 2)
+    del p1, p2

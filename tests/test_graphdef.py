@@ -298,3 +298,51 @@ def test_load_graphdef_from_file(tmp_path):
     p = import_graphdef(g, fetches=["z"])
     out = tfs.map_blocks(p, frame({"x": np.arange(3.0)}))
     np.testing.assert_allclose(out.column("z").data, np.arange(3.0) + 5)
+
+
+# --------------------------------------------------- review regressions --
+
+
+def test_batch_matmul_adjoint_attrs():
+    # adj_x/adj_y must transpose the last two dims (TF BatchMatMulV2 attrs)
+    a = np.arange(4.0).reshape(1, 2, 2)
+    bm = np.array([[[1.0, 2.0], [3.0, 4.0]]])
+    for opname in ("BatchMatMul", "BatchMatMulV2"):
+        b = GraphBuilder()
+        b.placeholder("a", "float64", [-1, 2, 2])
+        b.const("w", bm[0])
+        b.op(opname, "z", ["a", "w"], adj_y=True)
+        p = import_graphdef(b.build(), fetches=["z"])
+        tf = frame({"a": a})
+        out = tfs.map_blocks(p, tf)
+        np.testing.assert_allclose(
+            out.column("z").data, a @ bm.transpose(0, 2, 1)
+        )
+
+
+def test_packed_bool_list_attr_roundtrip():
+    import tensorframes_tpu.graphdef.proto as proto
+    import tensorframes_tpu.graphdef.wire as wire
+
+    # TF writers emit `repeated bool b = 5 [packed = true]` as one blob
+    packed = bytearray()
+    wire.write_len_field(packed, 5, b"\x01\x00\x01")
+    list_value = bytearray()
+    wire.write_len_field(list_value, 1, bytes(packed))
+    av = proto.AttrValue.parse(bytes(list_value))
+    assert av.kind == "list"
+    assert av.value == [True, False, True]
+
+
+def test_float_range_lowering():
+    b = GraphBuilder()
+    b.placeholder("x", "float64", [-1])
+    b.const("start", np.float64(0.0))
+    b.const("limit", np.float64(1.0))
+    b.const("delta", np.float64(0.25))
+    b.op("Range", "r", ["start", "limit", "delta"])
+    b.op("Sum", "s", ["r", b.const("axis", np.int32(0))])
+    b.op("Mul", "z", ["x", "s"])
+    p = import_graphdef(b.build(), fetches=["z"])
+    out = tfs.map_blocks(p, frame({"x": np.ones(3)}))
+    np.testing.assert_allclose(out.column("z").data, np.full(3, 1.5))

@@ -1,0 +1,160 @@
+"""Model-family tests: MLP scoring, logistic-regression gradient-sum,
+K-Means (both aggregation strategies) — each checked against a NumPy oracle,
+the analog of the reference's golden cross-language tests (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.models import kmeans, logistic_regression, mlp
+from tensorframes_tpu.parallel import MeshExecutor
+
+
+def _np_mlp(params, x):
+    h = x
+    for layer in params[:-1]:
+        h = np.maximum(h @ np.asarray(layer["w"]) + np.asarray(layer["b"]), 0)
+    return h @ np.asarray(params[-1]["w"]) + np.asarray(params[-1]["b"])
+
+
+class TestMLP:
+    def test_map_rows_scoring_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        params = mlp.init(jax.random.PRNGKey(0), [8, 16, 4], dtype=jnp.float64)
+        x = rng.randn(12, 8)
+        frame = tfs.TensorFrame.from_arrays({"image": x}, num_blocks=3)
+        out = tfs.map_rows(mlp.scoring_program(params), frame)
+        got = out.to_arrays()
+        want = _np_mlp(params, x)
+        np.testing.assert_allclose(got["logits"], want, rtol=1e-10)
+        np.testing.assert_array_equal(
+            got["prediction"], np.argmax(want, axis=1)
+        )
+
+    def test_feed_dict_column_remap(self):
+        params = mlp.init(jax.random.PRNGKey(1), [4, 3], dtype=jnp.float64)
+        x = np.random.RandomState(1).randn(6, 4)
+        frame = tfs.TensorFrame.from_arrays({"pixels": x}, num_blocks=2)
+        out = tfs.map_rows(
+            mlp.scoring_program(params), frame, feed_dict={"image": "pixels"}
+        )
+        np.testing.assert_allclose(
+            out.to_arrays()["logits"], _np_mlp(params, x), rtol=1e-10
+        )
+
+    def test_block_scoring_matches_row_scoring(self):
+        params = mlp.init(jax.random.PRNGKey(2), [5, 7, 2], dtype=jnp.float64)
+        x = np.random.RandomState(2).randn(10, 5)
+        frame = tfs.TensorFrame.from_arrays({"image": x}, num_blocks=2)
+        a = tfs.map_rows(mlp.scoring_program(params), frame).to_arrays()
+        b = tfs.map_blocks(mlp.block_scoring_program(params), frame).to_arrays()
+        np.testing.assert_allclose(a["logits"], b["logits"], rtol=1e-10)
+
+
+class TestLogisticRegression:
+    def _data(self, n=200, d=5, seed=0):
+        rng = np.random.RandomState(seed)
+        w_true = rng.randn(d)
+        x = rng.randn(n, d)
+        y = (x @ w_true + 0.1 * rng.randn(n) > 0).astype(np.float64)
+        return x, y, w_true
+
+    def test_gradient_matches_full_batch_autodiff(self):
+        x, y, _ = self._data()
+        frame = tfs.TensorFrame.from_arrays(
+            {"features": x, "label": y}, num_blocks=4
+        )
+        params = {
+            "w": jnp.asarray(np.ones(5) * 0.1),
+            "b": jnp.asarray(0.2),
+        }
+        partials = tfs.map_blocks(
+            logistic_regression.grad_program(params), frame, trim=True
+        )
+        summed = tfs.reduce_blocks(
+            logistic_regression._sum_program(), partials
+        )
+        # oracle: jax.grad of the summed loss over the whole dataset at once
+        g = jax.grad(logistic_regression._loss)(
+            params, jnp.asarray(x), jnp.asarray(y)
+        )
+        np.testing.assert_allclose(summed["grad_w"], g["w"], rtol=1e-8)
+        np.testing.assert_allclose(summed["grad_b"], g["b"], rtol=1e-8)
+        assert float(summed["count"]) == 200.0
+
+    def test_fit_learns_separable_data(self):
+        x, y, _ = self._data(n=400, d=4, seed=3)
+        frame = tfs.TensorFrame.from_arrays(
+            {"features": x, "label": y}, num_blocks=4
+        )
+        params, losses = logistic_regression.fit(frame, num_iters=60, lr=0.5)
+        assert losses[-1] < losses[0] * 0.5
+        acc = (logistic_regression.predict(params, x) == y).mean()
+        assert acc > 0.95
+
+    def test_fit_on_mesh_executor(self, devices):
+        x, y, _ = self._data(n=256, d=4, seed=4)
+        frame = tfs.TensorFrame.from_arrays(
+            {"features": x, "label": y}, num_blocks=8
+        )
+        eng = MeshExecutor(mode="per_block")
+        params_mesh, _ = logistic_regression.fit(
+            frame, num_iters=20, lr=0.5, engine=eng
+        )
+        params_local, _ = logistic_regression.fit(frame, num_iters=20, lr=0.5)
+        np.testing.assert_allclose(
+            params_mesh["w"], params_local["w"], rtol=1e-6
+        )
+
+
+class TestKMeans:
+    def _blobs(self, seed=0, n_per=60, d=3, k=4):
+        rng = np.random.RandomState(seed)
+        # well-separated deterministic centers (hypercube corners * 10)
+        corners = np.array(
+            [[(g >> i) & 1 for i in range(d)] for g in range(k)], dtype=float
+        )
+        centers = (corners * 2 - 1) * 10.0
+        pts = np.concatenate(
+            [c + rng.randn(n_per, d) for c in centers], axis=0
+        )
+        order = rng.permutation(len(pts))
+        return pts[order], centers
+
+    def _oracle_step(self, centers, pts):
+        d2 = ((pts[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+        idx = d2.argmin(1)
+        new = centers.copy()
+        for j in range(len(centers)):
+            if (idx == j).any():
+                new[j] = pts[idx == j].mean(0)
+        return new
+
+    def test_step_matches_oracle_both_strategies(self):
+        pts, _ = self._blobs()
+        frame = tfs.TensorFrame.from_arrays({"points": pts}, num_blocks=4)
+        init = pts[:4].copy()
+        want = self._oracle_step(init, pts)
+        for strategy in ("preagg", "aggregate"):
+            got = kmeans.step(init, frame, strategy=strategy)
+            np.testing.assert_allclose(got, want, rtol=1e-8, err_msg=strategy)
+
+    def test_fit_recovers_blobs(self):
+        pts, true_centers = self._blobs(seed=7)
+        frame = tfs.TensorFrame.from_arrays({"points": pts}, num_blocks=4)
+        centers, assign = kmeans.fit(frame, k=4, num_iters=15, seed=1)
+        # every true center has a learned center within a small distance
+        for c in true_centers:
+            assert np.min(np.linalg.norm(centers - c, axis=1)) < 1.0
+        assert assign.shape == (len(pts),)
+
+    def test_preagg_on_mesh_matches_local(self, devices):
+        pts, _ = self._blobs(seed=9)
+        frame = tfs.TensorFrame.from_arrays({"points": pts}, num_blocks=8)
+        init = pts[:4].copy()
+        eng = MeshExecutor(mode="per_block")
+        got = kmeans.step(init, frame, strategy="preagg", engine=eng)
+        want = kmeans.step(init, frame, strategy="preagg")
+        np.testing.assert_allclose(got, want, rtol=1e-8)

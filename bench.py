@@ -1,0 +1,97 @@
+"""Headline benchmark: ``map_blocks`` model-scoring throughput (rows/sec).
+
+This is BASELINE.json's primary metric family — block model scoring via
+``tfs.map_blocks`` (the reference's frozen-graph image-scoring path,
+``read_image.py:108-167``; its per-partition CPU TF sessions are the baseline
+being replaced).  Input rows are uint8 image vectors, normalised on device —
+the reference likewise ships raw bytes and decodes/casts inside the graph
+(``read_image.py:164-167``), keeping host->device traffic at 1 byte/pixel.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+measured directly: the identical scoring computation run through NumPy/BLAS on
+the host CPU — the stand-in for the reference's CPU-TF data plane.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _timeit(fn, reps: int, warmup: int) -> float:
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.models import mlp
+
+    n_rows = 65_536
+    features = 784
+    layers = [features, 2048, 2048, 2048, 1024, 10]
+
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, size=(n_rows, features), dtype=np.uint8)
+    params = mlp.init(jax.random.PRNGKey(0), layers, dtype=jnp.float32)
+    frame = tfs.TensorFrame.from_arrays({"image": images}, num_blocks=1)
+
+    def score(image):
+        x = image.astype(jnp.float32) / 255.0
+        logits = mlp.apply(params, x)
+        return {"prediction": jnp.argmax(logits, axis=-1)}
+
+    # wrap once: the Program's jit cache persists across reps (SURVEY.md P6)
+    program = tfs.Program.wrap(score, fetches=["prediction"])
+
+    def run_tpu():
+        out = tfs.map_blocks(program, frame)
+        np.asarray(out.column("prediction").data)
+
+    tpu_s = _timeit(run_tpu, reps=3, warmup=1)
+    rows_per_s = n_rows / tpu_s
+
+    # NumPy/BLAS oracle of the identical computation on a subset, scaled —
+    # the CPU data-plane stand-in for the reference's per-partition TF run.
+    np_params = [
+        {k: np.asarray(v) for k, v in layer.items()} for layer in params
+    ]
+    sub = images[:4096]
+
+    def run_cpu():
+        h = sub.astype(np.float32) / 255.0
+        for layer in np_params[:-1]:
+            h = np.maximum(h @ layer["w"] + layer["b"], 0.0)
+        logits = h @ np_params[-1]["w"] + np_params[-1]["b"]
+        logits.argmax(-1)
+
+    cpu_s = _timeit(run_cpu, reps=2, warmup=1) * (n_rows / len(sub))
+    baseline_rows_per_s = n_rows / cpu_s
+
+    print(
+        json.dumps(
+            {
+                "metric": "map_blocks model-scoring throughput",
+                "value": round(rows_per_s, 1),
+                "unit": "rows/sec/chip",
+                "vs_baseline": round(rows_per_s / baseline_rows_per_s, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -77,6 +77,7 @@ float32 = ScalarType("float32", np.dtype(np.float32), TF_FLOAT, None)
 float64 = ScalarType("float64", np.dtype(np.float64), TF_DOUBLE, float)
 int32 = ScalarType("int32", np.dtype(np.int32), TF_INT32, None)
 int64 = ScalarType("int64", np.dtype(np.int64), TF_INT64, int)
+uint8 = ScalarType("uint8", np.dtype(np.uint8), TF_UINT8, None)
 bool_ = ScalarType("bool", np.dtype(np.bool_), TF_BOOL, bool)
 bfloat16 = (
     ScalarType("bfloat16", np.dtype(jnp.bfloat16), TF_BFLOAT16, None)
@@ -85,7 +86,11 @@ bfloat16 = (
 )
 binary = ScalarType("binary", np.dtype(object), TF_STRING, bytes, device_ok=False)
 
-_ALL = [t for t in (float32, float64, int32, int64, bool_, bfloat16, binary) if t]
+_ALL = [
+    t
+    for t in (float32, float64, int32, int64, uint8, bool_, bfloat16, binary)
+    if t
+]
 
 _BY_NAME: Dict[str, ScalarType] = {t.name: t for t in _ALL}
 _BY_NP: Dict[np.dtype, ScalarType] = {t.np_dtype: t for t in _ALL if t.device_ok}

@@ -12,11 +12,15 @@ Re-designs ``/root/reference/src/main/python/tensorframes_snippets/kmeans_demo.p
   partials across blocks — on a MeshExecutor that combine is an ICI psum
   instead of Spark's driver reduce.
 
-Like the demo (L68-80), each iteration re-embeds the updated centers into a
-fresh program: the closure re-jits per iteration in exchange for centers
-being XLA constants.  Distance kernel: ||x-c||^2 = ||x||^2 - 2 x.c + ||c||^2
-with the cross term as one MXU matmul (demo L55-60 computes the same via
-squared_distance; the matmul form is the TPU-shaped variant).
+Where the demo re-embeds the updated centers into a fresh graph every
+iteration and re-broadcasts it (demo L68-80), here the centers are Program
+*params* — traced arguments of a compiled executable that is built once and
+reused for every Lloyd iteration (``Program.update_params``): zero re-trace,
+zero re-compile, zero re-broadcast in the iteration loop.
+
+Distance kernel: ||x-c||^2 = ||x||^2 - 2 x.c + ||c||^2 with the cross term as
+one MXU matmul (demo L55-60 computes the same via squared_distance; the matmul
+form is the TPU-shaped variant).
 """
 
 from __future__ import annotations
@@ -29,6 +33,7 @@ import numpy as np
 from ..frame import TensorFrame
 from ..ops import aggregate, group_by, map_blocks, reduce_blocks
 from ..ops.engine import Executor
+from ..program import Program
 
 
 def _closest(points: jnp.ndarray, centers: jnp.ndarray) -> jnp.ndarray:
@@ -38,48 +43,43 @@ def _closest(points: jnp.ndarray, centers: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmin(c2[None, :] - 2.0 * cross, axis=1)
 
 
-def assignment_program(centers):
-    """``map_blocks``: ``points`` [n, d] -> ``closest`` [n] (demo L46-66)."""
-    c = jnp.asarray(centers)
-
-    def fn(points):
-        return {"closest": _closest(points, c).astype(jnp.int64)}
-
-    return fn
+def _assign_fn(points, centers):
+    return {"closest": _closest(points, centers).astype(jnp.int64)}
 
 
-def preagg_program(centers):
+def _preagg_fn(points, centers):
+    idx = _closest(points, centers)
+    k = centers.shape[0]
+    onehot = (idx[:, None] == jnp.arange(k)[None, :]).astype(points.dtype)
+    # segment_sum as [k, n] @ [n, d] — keeps the hot op on the MXU for
+    # large n instead of scatter-adds
+    sums = onehot.T @ points
+    counts = onehot.sum(axis=0)
+    return {"psum": sums[None], "pcount": counts[None]}
+
+
+def _combine_fn(psum_input, pcount_input):
+    return {"psum": psum_input.sum(0), "pcount": pcount_input.sum(0)}
+
+
+def _agg_sum_fn(points_input, one_input):
+    return {"points": points_input.sum(0), "one": one_input.sum(0)}
+
+
+def assignment_program(centers) -> Program:
+    """``map_blocks``: ``points`` [n, d] -> ``closest`` [n] (demo L46-66).
+
+    ``centers`` is a param: ``program.update_params(centers=...)`` between
+    calls reuses the compiled executable."""
+    return Program.wrap(_assign_fn, params={"centers": jnp.asarray(centers)})
+
+
+def preagg_program(centers) -> Program:
     """``map_blocks_trimmed``: block [n, d] -> ONE partial row with cells
     ``psum`` [k, d], ``pcount`` [k] (demo L128-148's per-block
     ``unsorted_segment_sum``; one row per block so the later cross-block
     ``reduce_blocks`` sum is per-cluster)."""
-    c = jnp.asarray(centers)
-    k = c.shape[0]
-
-    def fn(points):
-        idx = _closest(points, c)
-        onehot = (idx[:, None] == jnp.arange(k)[None, :]).astype(points.dtype)
-        # segment_sum as [k, n] @ [n, d] — keeps the hot op on the MXU for
-        # large n instead of scatter-adds
-        sums = onehot.T @ points
-        counts = onehot.sum(axis=0)
-        return {"psum": sums[None], "pcount": counts[None]}
-
-    return fn
-
-
-def _combine_program():
-    def fn(psum_input, pcount_input):
-        return {"psum": psum_input.sum(0), "pcount": pcount_input.sum(0)}
-
-    return fn
-
-
-def _agg_sum_program():
-    def fn(points_input, one_input):
-        return {"points": points_input.sum(0), "one": one_input.sum(0)}
-
-    return fn
+    return Program.wrap(_preagg_fn, params={"centers": jnp.asarray(centers)})
 
 
 def step(
@@ -87,18 +87,31 @@ def step(
     frame: TensorFrame,
     strategy: str = "preagg",
     engine: Optional[Executor] = None,
+    _programs: Optional[dict] = None,
 ) -> np.ndarray:
-    """One Lloyd iteration -> new centers [k, d]."""
+    """One Lloyd iteration -> new centers [k, d].
+
+    ``_programs``: compiled-program cache threaded by ``fit`` so the
+    iteration loop reuses one executable per program."""
     k, d = centers.shape
+    progs = _programs if _programs is not None else {}
     if strategy == "preagg":
+        if "preagg" not in progs:
+            progs["preagg"] = preagg_program(centers)
+            progs["combine"] = Program.wrap(_combine_fn)
+        progs["preagg"].update_params(centers=jnp.asarray(centers))
         partials = map_blocks(
-            preagg_program(centers), frame, trim=True, engine=engine
+            progs["preagg"], frame, trim=True, engine=engine
         )
-        total = reduce_blocks(_combine_program(), partials, engine=engine)
+        total = reduce_blocks(progs["combine"], partials, engine=engine)
         sums = np.asarray(total["psum"])
         counts = np.asarray(total["pcount"])
     elif strategy == "aggregate":
-        assigned = map_blocks(assignment_program(centers), frame, engine=engine)
+        if "assign" not in progs:
+            progs["assign"] = assignment_program(centers)
+            progs["agg_sum"] = Program.wrap(_agg_sum_fn)
+        progs["assign"].update_params(centers=jnp.asarray(centers))
+        assigned = map_blocks(progs["assign"], frame, engine=engine)
         arrs = assigned.to_arrays()
         witheach = TensorFrame.from_arrays(
             {
@@ -109,7 +122,7 @@ def step(
             num_blocks=frame.num_blocks,
         )
         grouped = aggregate(
-            _agg_sum_program(), group_by(witheach, "closest"), engine=engine
+            progs["agg_sum"], group_by(witheach, "closest"), engine=engine
         )
         out = grouped.to_arrays()
         sums = np.zeros((k, d))
@@ -152,7 +165,12 @@ def fit(
             )
             chosen.append(int(np.argmax(d2)))
         centers = pts[chosen].copy()
+    programs: dict = {}
     for _ in range(num_iters):
-        centers = np.asarray(step(centers, frame, strategy, engine))
-    assigned = map_blocks(assignment_program(centers), frame, engine=engine)
+        centers = np.asarray(
+            step(centers, frame, strategy, engine, _programs=programs)
+        )
+    assign = programs.get("assign") or assignment_program(centers)
+    assign.update_params(centers=jnp.asarray(centers))
+    assigned = map_blocks(assign, frame, engine=engine)
     return centers, np.asarray(assigned.to_arrays()["closest"])

@@ -133,7 +133,7 @@ class Executor:
         """``mapRows`` (``DebugRowOps.scala:396-477``): the program is written
         at *cell* level and vmapped over the block's rows."""
         infos = validation.check_map_inputs(program, frame, "map_rows")
-        vmapped = jax.jit(jax.vmap(lambda ins: program.call(ins)))
+        vmapped = program.vmapped()
         out_blocks: List[Dict[str, np.ndarray]] = []
         for bi in range(frame.num_blocks):
             block = frame.block(bi)
@@ -178,20 +178,20 @@ class Executor:
     # ------------------------------------------------------------- reduce --
 
     def _pair_call(self, program: Program, bases: Sequence[str]):
-        def pairfn(left: Dict[str, Any], right: Dict[str, Any]):
+        def pairfn(left: Dict[str, Any], right: Dict[str, Any], params):
             inputs = {}
             for b in bases:
                 inputs[f"{b}_1"] = left[b]
                 inputs[f"{b}_2"] = right[b]
-            return program.call(inputs)
+            return program.call(inputs, params)
 
         return pairfn
 
     def _tree_fold(
-        self, pairfn, arrays: Dict[str, jnp.ndarray]
+        self, pairfn, arrays: Dict[str, jnp.ndarray], params
     ) -> Dict[str, jnp.ndarray]:
         """Balanced deterministic tree fold over the lead axis (static size)."""
-        vpair = jax.vmap(pairfn)
+        vpair = jax.vmap(pairfn, in_axes=(0, 0, None))
 
         def fold(arrs: Dict[str, jnp.ndarray]):
             n = next(iter(arrs.values())).shape[0]
@@ -202,7 +202,7 @@ class Executor:
             half = n // 2
             left = {k: v[:half] for k, v in arrs.items()}
             right = {k: v[half : 2 * half] for k, v in arrs.items()}
-            combined = vpair(left, right)
+            combined = vpair(left, right, params)
             if n % 2:
                 combined = {
                     k: jnp.concatenate([v, arrs[k][2 * half :]])
@@ -213,7 +213,7 @@ class Executor:
         return fold(arrays)
 
     def _seq_fold(
-        self, pairfn, arrays: Dict[str, jnp.ndarray]
+        self, pairfn, arrays: Dict[str, jnp.ndarray], params
     ) -> Dict[str, jnp.ndarray]:
         """Left fold in row order — bit-exact reproduction of the reference's
         sequential pairwise reduction (``performReducePairwise``,
@@ -222,7 +222,7 @@ class Executor:
         rest = {k: v[1:] for k, v in arrays.items()}
 
         def step(carry, row):
-            return pairfn(carry, row), None
+            return pairfn(carry, row, params), None
 
         out, _ = jax.lax.scan(step, init, rest)
         return out
@@ -259,10 +259,10 @@ class Executor:
         pairfn = self._pair_call(program, bases)
         fold = self._tree_fold if mode == "tree" else self._seq_fold
 
-        @jax.jit
-        def run(arrs):
-            return fold(pairfn, arrs)
-
+        run = program.cached_jit(
+            ("reduce_rows", mode, tuple(bases)),
+            lambda: lambda arrs, params: fold(pairfn, arrs, params),
+        )
         return bases, reduced, run
 
     def reduce_rows(
@@ -320,10 +320,12 @@ class Executor:
         )
         validation.check_reduce_blocks_outputs(reduced, summaries, verb=verb)
 
-        def block_call(arrs: Dict[str, jnp.ndarray]):
-            return program.call({f"{b}_input": arrs[b] for b in bases})
-
-        run = jax.jit(block_call)
+        run = program.cached_jit(
+            (verb, tuple(bases)),
+            lambda: lambda arrs, params: program.call(
+                {f"{b}_input": arrs[b] for b in bases}, params
+            ),
+        )
         return bases, reduced, run
 
     def reduce_blocks(
@@ -425,10 +427,15 @@ class Executor:
                 st.np_dtype, copy=False
             )[order]
 
-        def block_call(arrs: Dict[str, jnp.ndarray]):
-            return program.call({f"{b}_input": arrs[b] for b in bases})
-
-        vrun = jax.jit(jax.vmap(block_call))
+        vrun = program.cached_jit(
+            ("aggregate_v", tuple(bases)),
+            lambda: lambda arrs, params: jax.vmap(
+                lambda a: program.call(
+                    {f"{b}_input": a[b] for b in bases}, params
+                ),
+                in_axes=(0,),
+            )(arrs),
+        )
 
         # --- size-bucketed vmap over groups ---
         out_cells: Dict[str, List[Tuple[int, np.ndarray]]] = {b: [] for b in bases}

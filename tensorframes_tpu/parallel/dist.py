@@ -169,12 +169,15 @@ class MeshExecutor(Executor):
                 f"map_blocks(per_block): frame has {n} rows < {d} devices; "
                 f"use the global mode or fewer devices"
             )
-        local = jax.shard_map(
-            lambda ins: program.call(ins),
-            mesh=self.mesh,
-            in_specs=P(self.axis),
-            out_specs=P(self.axis),
-            check_vma=False,
+        run_local = program.cached_jit(
+            ("map_blocks_shardmap", self.mesh, self.axis),
+            lambda: jax.shard_map(
+                lambda ins, ps: program.call(ins, ps),
+                mesh=self.mesh,
+                in_specs=(P(self.axis), P()),
+                out_specs=P(self.axis),
+                check_vma=False,
+            ),
         )
         sh = self._shard()
         inputs = {}
@@ -186,7 +189,7 @@ class MeshExecutor(Executor):
             inputs[name] = jax.device_put(arr[:n_even], sh)
             if n_even < n:
                 tail_inputs[name] = jnp.asarray(arr[n_even:])
-        outs = jax.jit(local)(inputs)
+        outs = run_local(inputs)
         host = {k: _np(v) for k, v in outs.items()}
         if tail_inputs:
             # remainder rows form one extra block, run unsharded
@@ -220,8 +223,7 @@ class MeshExecutor(Executor):
             if pad:
                 arr = np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)])
             inputs[name] = jax.device_put(arr, sh)
-        vmapped = jax.jit(jax.vmap(lambda ins: program.call(ins)))
-        outs = vmapped(inputs)
+        outs = program.vmapped()(inputs)
         host = {k: _np(v)[:n] for k, v in outs.items()}
         return self._finish_map(frame, host, trim=False)
 
@@ -269,18 +271,24 @@ class MeshExecutor(Executor):
 
         sh = self._shard()  # n_even is divisible by construction
 
-        def local(arrs):
-            out = program.call(
-                {f"{b}_input": arrs[b] for b in bases}
-            )
-            return {k: v[None] for k, v in out.items()}
+        def build():
+            def local(arrs, ps):
+                out = program.call(
+                    {f"{b}_input": arrs[b] for b in bases}, ps
+                )
+                return {k: v[None] for k, v in out.items()}
 
-        localized = jax.shard_map(
-            local,
-            mesh=self.mesh,
-            in_specs=P(self.axis),
-            out_specs=P(self.axis),
-            check_vma=False,
+            return jax.shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(P(self.axis), P()),
+                out_specs=P(self.axis),
+                check_vma=False,
+            )
+
+        run_localized = program.cached_jit(
+            ("reduce_blocks_shardmap", self.mesh, self.axis, tuple(bases)),
+            build,
         )
         arrays = {}
         tails = {}
@@ -289,7 +297,7 @@ class MeshExecutor(Executor):
             arrays[b] = jax.device_put(arr[:n_even], sh)
             if n_even < n:
                 tails[b] = jnp.asarray(arr[n_even:])
-        partials = jax.jit(localized)(arrays)  # dict base -> [d, *cell]
+        partials = run_localized(arrays)  # dict base -> [d, *cell]
         # partials are d rows — host-stack them (cheap) so the final combine
         # runs unsharded, mirroring the reference's phase-2 combine
         stacked = {b: _np(partials[b]) for b in bases}

@@ -78,10 +78,27 @@ class Program:
         input_names: Sequence[str],
         fetches: Optional[Sequence[str]] = None,
         feed_dict: Optional[Mapping[str, str]] = None,
+        params: Optional[Mapping[str, Any]] = None,
     ):
         self._fn = fn
-        self._input_names = list(input_names)
         self._declared_fetches = list(fetches) if fetches is not None else None
+        all_names = list(input_names)
+        self._params: Dict[str, Any] = {
+            k: jnp.asarray(v) for k, v in (params or {}).items()
+        }
+        for k in self._params:
+            if k not in all_names:
+                raise ProgramError(
+                    f"params key {k!r} is not a program argument; "
+                    f"arguments are {all_names}"
+                )
+        # column-fed inputs exclude param-fed arguments
+        self._input_names = [n for n in all_names if n not in self._params]
+        if not self._input_names:
+            raise ProgramError(
+                "a program needs at least one column-fed input (all "
+                "arguments were bound by params)"
+            )
         self._feed = dict(feed_dict or {})
         for k in self._feed:
             if k not in self._input_names:
@@ -91,6 +108,8 @@ class Program:
                 )
         self._fetches: Optional[List[str]] = None  # resolved at first trace
         self._jitted = None
+        self._vmapped = None
+        self._derived: Dict[Any, Any] = {}
 
     # -- construction --------------------------------------------------------
 
@@ -99,8 +118,14 @@ class Program:
         fn_or_program,
         fetches: Optional[Sequence[str]] = None,
         feed_dict: Optional[Mapping[str, str]] = None,
+        params: Optional[Mapping[str, Any]] = None,
     ) -> "Program":
         if isinstance(fn_or_program, Program):
+            if params:
+                raise ProgramError(
+                    "cannot bind params on an existing Program; pass params "
+                    "when the program is created, or call update_params"
+                )
             if fetches is not None and sorted(fetches) != sorted(
                 fn_or_program._declared_fetches or []
             ):
@@ -119,6 +144,12 @@ class Program:
             and all(hasattr(x, "to_program") for x in fn_or_program)
         )
         if is_node or is_node_seq:
+            if params:
+                raise ProgramError(
+                    "params are not supported for DSL-node programs; use "
+                    "dsl.constant for fixed values or a python-function "
+                    "program for updatable params"
+                )
             from . import dsl  # local import: dsl depends on this module
 
             nodes = [fn_or_program] if is_node else list(fn_or_program)
@@ -155,21 +186,61 @@ class Program:
                 )
         if not names:
             raise ProgramError("a program needs at least one named input")
-        return Program(fn_or_program, names, fetches, feed_dict)
+        return Program(fn_or_program, names, fetches, feed_dict, params)
 
     def with_feed(self, feed_dict: Mapping[str, str]) -> "Program":
         """A copy with additional input->column renames merged in."""
         merged = dict(self._feed)
         merged.update(feed_dict)
         return Program(
-            self._fn, self._input_names, self._declared_fetches, merged
+            self._fn,
+            self._input_names + list(self._params),
+            self._declared_fetches,
+            merged,
+            self._params,
         )
 
     # -- accessors -----------------------------------------------------------
 
     @property
     def input_names(self) -> List[str]:
+        """Column-fed input names (param-bound arguments excluded)."""
         return list(self._input_names)
+
+    @property
+    def param_names(self) -> List[str]:
+        return list(self._params)
+
+    @property
+    def params(self) -> Dict[str, Any]:
+        return dict(self._params)
+
+    def update_params(self, **arrays) -> "Program":
+        """Replace param values in place (shapes/dtypes must match).
+
+        This is the iterative-driver contract: the reference re-embeds
+        updated constants into a fresh graph every step
+        (``kmeans_demo.py:68-80``, re-broadcast each iteration); here params
+        are *traced arguments* of the compiled executable, so a shape-stable
+        update reuses the jit cache — no re-trace, no re-compile, no
+        re-broadcast."""
+        for k, v in arrays.items():
+            if k not in self._params:
+                raise ProgramError(
+                    f"update_params: {k!r} is not a param; params are "
+                    f"{sorted(self._params)}"
+                )
+            old = self._params[k]
+            new = jnp.asarray(v)
+            if new.shape != old.shape or new.dtype != old.dtype:
+                raise ProgramError(
+                    f"update_params: {k!r} must keep shape {old.shape} / "
+                    f"dtype {old.dtype}, got {new.shape} / {new.dtype} "
+                    f"(shape changes force a re-compile; build a new "
+                    f"Program instead)"
+                )
+            self._params[k] = new
+        return self
 
     def column_for_input(self, name: str) -> str:
         """Frame column feeding a given input (identity unless feed_dict)."""
@@ -228,9 +299,21 @@ class Program:
             self._fetches = list(ordered)
         return ordered
 
-    def call(self, inputs: Mapping[str, Any]) -> Dict[str, Any]:
-        """Run the program (traceable; used inside jit/vmap/shard_map)."""
+    def call(
+        self,
+        inputs: Mapping[str, Any],
+        params: Optional[Mapping[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Run the program (traceable; used inside jit/vmap/shard_map).
+
+        ``params`` lets an enclosing jit pass the param values as *traced
+        arguments*; when omitted, the current ``self._params`` are captured
+        as trace-time constants (correct, but an enclosing jit built around
+        such a call bakes the values in)."""
+        if params is None:
+            params = self._params
         kwargs = {n: inputs[n] for n in self._input_names}
+        kwargs.update(params)
         return self._normalize_outputs(self._fn(**kwargs))
 
     def jitted(self):
@@ -238,13 +321,56 @@ class Program:
 
         jax's jit cache is the broadcast mechanism (SURVEY.md P6): every block
         with the same signature reuses the same XLA executable, on any device.
+        Params flow through as traced arguments, so ``update_params`` between
+        calls reuses the compiled executable.
         """
         if self._jitted is None:
-            def _run(inputs):
-                return self.call(inputs)
+            def _run(inputs, params):
+                return self.call(inputs, params)
 
-            self._jitted = jax.jit(_run)
+            self._jitted = self._bind_live_params(jax.jit(_run))
         return self._jitted
+
+    def vmapped(self):
+        """Compiled row-level entry: the cell program vmapped over the lead
+        axis (``map_rows``'s engine).  Cached like ``jitted``; params are
+        broadcast (not vmapped) and traced as arguments."""
+        if self._vmapped is None:
+            def _run(inputs, params):
+                return jax.vmap(
+                    lambda ins: self.call(ins, params), in_axes=(0,)
+                )(inputs)
+
+            self._vmapped = self._bind_live_params(jax.jit(_run))
+        return self._vmapped
+
+    def _bind_live_params(self, compiled):
+        """Bind the CURRENT params as the trailing traced argument at every
+        call — the one place where the live-params calling convention lives."""
+        return lambda *args: compiled(*args, self._params)
+
+    # cap on derived compiled callables kept per Program; oldest evicted
+    # first so a Program reused across many short-lived meshes/executors
+    # does not pin their executables forever
+    _DERIVED_CAP = 32
+
+    def cached_jit(self, key, build_raw):
+        """Memoize ``jax.jit(build_raw())`` with live params bound.
+
+        The verb engines build per-verb wrappers (pairwise folds, block
+        reducers, shard_maps) whose last positional argument is the params
+        dict; caching them here keyed by verb/mode/mesh means repeated verb
+        invocations on the same Program reuse one jit cache instead of
+        re-tracing per call, and ``update_params`` takes effect without
+        recompiling.  ``build_raw`` returns the raw traceable
+        ``fn(*args, params)``."""
+        if key not in self._derived:
+            while len(self._derived) >= self._DERIVED_CAP:
+                self._derived.pop(next(iter(self._derived)))
+            self._derived[key] = self._bind_live_params(
+                jax.jit(build_raw())
+            )
+        return self._derived[key]
 
     # -- analysis ------------------------------------------------------------
 

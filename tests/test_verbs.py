@@ -322,3 +322,50 @@ def test_program_analyze_summaries():
     assert {s.name: s for s in summ2}["z"].shape == (tfs.UNKNOWN,)
     with pytest.raises(tfs.ProgramError, match="non-existent"):
         p.analyze({"x": (dt.float32, (8,))}, hints={"nope": (1,)})
+
+
+def test_program_params_update_without_recompile():
+    """Params are traced arguments: update_params between calls reuses the
+    compiled executable (the iterative-driver contract replacing the
+    reference's per-iteration graph re-embed, kmeans_demo.py:68-80)."""
+    traces = []
+
+    def fn(x, shift):
+        traces.append(1)
+        return {"z": x + shift}
+
+    p = tfs.Program.wrap(fn, params={"shift": np.float64(3.0)})
+    assert p.input_names == ["x"]
+    assert p.param_names == ["shift"]
+    tf = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"x": np.arange(4.0)}, num_blocks=1)
+    )
+    out1 = tfs.map_blocks(p, tf)
+    np.testing.assert_allclose(out1.column("z").data, np.arange(4.0) + 3.0)
+    n_traces = len(traces)
+    p.update_params(shift=np.float64(10.0))
+    out2 = tfs.map_blocks(p, tf)
+    np.testing.assert_allclose(out2.column("z").data, np.arange(4.0) + 10.0)
+    assert len(traces) == n_traces, "update_params must not re-trace"
+    # shape-changing update is rejected (would force a silent re-compile)
+    with pytest.raises(tfs.ProgramError, match="shape"):
+        p.update_params(shift=np.zeros(3))
+    with pytest.raises(tfs.ProgramError, match="not a param"):
+        p.update_params(nope=1.0)
+
+
+def test_program_params_in_reduce_and_aggregate():
+    def combine(x_input, scale):
+        return {"x": x_input.sum(0) * scale}
+
+    p = tfs.Program.wrap(combine, params={"scale": np.float64(2.0)})
+    tf = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"x": np.arange(8.0)}, num_blocks=2)
+    )
+    out = tfs.reduce_blocks(p, tf)
+    # per-block sums scaled, then the stacked partials scaled again:
+    # ((0+1+2+3)*2 + (4+5+6+7)*2) * 2
+    assert float(out["x"]) == (6.0 * 2 + 22.0 * 2) * 2
+    p.update_params(scale=np.float64(1.0))
+    out2 = tfs.reduce_blocks(p, tf)
+    assert float(out2["x"]) == 28.0

@@ -1,0 +1,297 @@
+"""Decoder-only transformer LM — the framework's flagship model family.
+
+The reference's model story is frozen-graph *scoring* of conv nets
+(``/root/reference/src/main/python/tensorframes_snippets/read_image.py:108-167``);
+it has no in-repo model definitions, no attention, and no training loop
+(SURVEY.md §2.7).  The TPU-native build makes the modern equivalent
+first-class: a decoder-only transformer whose forward/training step shards
+over the standard 4-axis mesh (``parallel.mesh.training_mesh``):
+
+* ``dp`` — batch data parallelism;
+* ``tp`` — Megatron-style tensor parallelism: QKV/gate/up projections are
+  column-sharded ``P(None, "tp")``, output/down projections row-sharded
+  ``P("tp", None)``, so each block needs exactly one all-reduce per
+  sub-layer (inserted by GSPMD from the sharding constraints);
+* ``sp`` — sequence/context parallelism: activations are sharded along the
+  sequence axis ``P("dp", "sp", None)``; attention over the distributed
+  sequence runs as ring attention (``parallel.ring``) with K/V blocks
+  rotating over the ``sp`` ring via ``ppermute``;
+* ``pp`` — pipeline stages (``train.py`` stacks blocks per stage and
+  schedules microbatches over the ``pp`` axis).
+
+All matmuls run in bf16 on the MXU with f32 accumulation
+(``preferred_element_type``); params are kept in f32.  Sharding is expressed
+as *constraints* (``with_sharding_constraint``) against the ambient mesh, so
+the same code runs unsharded on one chip and GSPMD-partitioned on a pod —
+constraints over axes absent from the ambient mesh are dropped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 6
+    n_heads: int = 8
+    n_kv_heads: int = 8  # < n_heads => grouped-query attention
+    d_ff: int = 2048  # SwiGLU hidden size
+    max_seq: int = 2048
+    rope_theta: float = 10_000.0
+    dtype: Any = jnp.bfloat16  # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    attn_impl: str = "full"  # "full" | "ring" (sp-distributed)
+    remat: bool = False  # rematerialise blocks (jax.checkpoint)
+
+    def __post_init__(self):
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must be divisible by n_heads")
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError("n_heads must be divisible by n_kv_heads")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def shard(x: jnp.ndarray, *spec) -> jnp.ndarray:
+    """Constrain ``x``'s sharding against the ambient mesh.
+
+    Axes named in ``spec`` but absent from the ambient mesh are dropped, so
+    model code states its ideal layout once and degrades gracefully on
+    smaller meshes (or none).  Entries may be ``None``, an axis name, or a
+    tuple of axis names.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return x
+    # axes already bound as Manual (we are inside a shard_map over them,
+    # e.g. the pipeline stage body) cannot be constrained again — drop them
+    types = dict(zip(mesh.axis_names, mesh.axis_types))
+    names = {
+        n
+        for n in mesh.axis_names
+        if types[n] != jax.sharding.AxisType.Manual
+    }
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return jax.lax.with_sharding_constraint(x, P(*(keep(e) for e in spec)))
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    """Parameter pytree.  Layout (per block): fused qkv? no — separate
+    wq/wk/wv so tp sharding of GQA kv heads stays independent."""
+    d, h, kvh, dh, f = (
+        cfg.d_model,
+        cfg.n_heads,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+        cfg.d_ff,
+    )
+    pd = cfg.param_dtype
+    k_embed, k_blocks, k_head = jax.random.split(rng, 3)
+
+    def dense(key, fan_in, shape):
+        return (
+            jax.random.normal(key, shape, pd) * np.sqrt(1.0 / fan_in)
+        ).astype(pd)
+
+    def block_params(key) -> Params:
+        ks = jax.random.split(key, 7)
+        return {
+            "ln1": jnp.ones((d,), pd),
+            "wq": dense(ks[0], d, (d, h * dh)),
+            "wk": dense(ks[1], d, (d, kvh * dh)),
+            "wv": dense(ks[2], d, (d, kvh * dh)),
+            "wo": dense(ks[3], h * dh, (h * dh, d)),
+            "ln2": jnp.ones((d,), pd),
+            "w_gate": dense(ks[4], d, (d, f)),
+            "w_up": dense(ks[5], d, (d, f)),
+            "w_down": dense(ks[6], f, (f, d)),
+        }
+
+    # blocks are STACKED on a lead [n_layers, ...] axis: scanned in apply()
+    # (one trace for all layers) and shardable over "pp" by the pipeline
+    # schedule in train.py
+    blocks = jax.vmap(block_params)(jax.random.split(k_blocks, cfg.n_layers))
+    return {
+        "embed": dense(k_embed, d, (cfg.vocab_size, d)),
+        "blocks": blocks,
+        "ln_f": jnp.ones((d,), pd),
+        "lm_head": dense(k_head, d, (d, cfg.vocab_size)),
+    }
+
+
+def shard_params(params: Params) -> Params:
+    """Apply the canonical tp layout constraints to a param pytree (no-op
+    without an ambient mesh).  The pipeline layer adds the ``pp`` lead-axis
+    sharding on top (``train.py``)."""
+    p = dict(params)
+    p["embed"] = shard(params["embed"], "tp", None)
+    p["lm_head"] = shard(params["lm_head"], None, "tp")
+    b = dict(params["blocks"])
+    for k in ("wq", "wk", "wv", "w_gate", "w_up"):
+        b[k] = shard(b[k], None, None, "tp")  # lead axis = layers
+    for k in ("wo", "w_down"):
+        b[k] = shard(b[k], None, "tp", None)
+    p["blocks"] = b
+    return p
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float = 1e-6):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float):
+    """Rotary embedding.  x: [B, L, H, Dh]; positions: [B, L] (absolute)."""
+    dh = x.shape[-1]
+    freqs = theta ** (
+        -jnp.arange(0, dh // 2, dtype=jnp.float32) / (dh // 2)
+    )
+    ang = positions[..., None].astype(jnp.float32) * freqs  # [B, L, Dh/2]
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+# attention numerics live in parallel.ring (full_attention is the shared
+# non-ring kernel; ring_attention the sp-distributed one)
+
+
+def _block(
+    bp: Params, x: jnp.ndarray, positions: jnp.ndarray, cfg: TransformerConfig
+) -> jnp.ndarray:
+    """One decoder block.  x: [B, L, D] (L may be the sp-local chunk when
+    ring attention is on — positions carry the global offsets)."""
+    B, L, D = x.shape
+    h, kvh, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    # -- attention ----------------------------------------------------------
+    y = _rms_norm(x, bp["ln1"])
+    q = (y @ bp["wq"].astype(dt)).reshape(B, L, h, dh)
+    k = (y @ bp["wk"].astype(dt)).reshape(B, L, kvh, dh)
+    v = (y @ bp["wv"].astype(dt)).reshape(B, L, kvh, dh)
+    q = shard(_rope(q, positions, cfg.rope_theta), "dp", "sp", "tp", None)
+    k = shard(_rope(k, positions, cfg.rope_theta), "dp", "sp", "tp", None)
+    v = shard(v, "dp", "sp", "tp", None)
+    if kvh != h:
+        k = jnp.repeat(k, h // kvh, axis=2)
+        v = jnp.repeat(v, h // kvh, axis=2)
+    from ..parallel.ring import full_attention, ring_attention
+
+    if cfg.attn_impl == "ring":
+        att = ring_attention(q, k, v, causal=True)
+    else:
+        att = full_attention(q, k, v, True, positions, positions)
+    att = att.reshape(B, L, h * dh)
+    x = x + shard(att @ bp["wo"].astype(dt), "dp", "sp", None)
+
+    # -- SwiGLU MLP ---------------------------------------------------------
+    y = _rms_norm(x, bp["ln2"])
+    gate = jax.nn.silu(y @ bp["w_gate"].astype(dt))
+    up = y @ bp["w_up"].astype(dt)
+    ff = shard(gate * up, "dp", "sp", "tp")
+    x = x + shard(ff @ bp["w_down"].astype(dt), "dp", "sp", None)
+    return x
+
+
+def apply_blocks(
+    blocks: Params,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    cfg: TransformerConfig,
+) -> jnp.ndarray:
+    """Scan the stacked block params over x — one trace for all layers."""
+    body = _block
+    if cfg.remat:
+        body = jax.checkpoint(body, static_argnums=(3,))
+
+    def step(carry, bp):
+        return body(bp, carry, positions, cfg), None
+
+    out, _ = jax.lax.scan(step, x, blocks)
+    return out
+
+
+def apply(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    positions: Optional[jnp.ndarray] = None,
+    blocks_runner=None,
+) -> jnp.ndarray:
+    """tokens [B, L] int32 -> logits [B, L, V] (f32).
+
+    ``blocks_runner(blocks, x, positions, cfg)`` overrides how the decoder
+    stack runs (default sequential ``apply_blocks``; the training layer
+    passes the GPipe pipeline, ``train.pipelined_blocks``)."""
+    B, L = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+    if blocks_runner is None:
+        blocks_runner = apply_blocks
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = shard(x, "dp", "sp", None)
+    x = blocks_runner(params["blocks"], x, positions, cfg)
+    x = _rms_norm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "bld,dv->blv",
+        x,
+        params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return shard(logits, "dp", "sp", "tp")
+
+
+def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross-entropy over valid targets (-1 = ignore)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    valid = targets >= 0
+    safe = jnp.where(valid, targets, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def loss_fn(
+    params: Params,
+    tokens: jnp.ndarray,
+    targets: jnp.ndarray,
+    cfg: TransformerConfig,
+    blocks_runner=None,
+) -> jnp.ndarray:
+    """Mean next-token cross-entropy.  targets [B, L] int32 (-1 = ignore)."""
+    return cross_entropy(
+        apply(params, tokens, cfg, blocks_runner=blocks_runner), targets
+    )

@@ -1,0 +1,285 @@
+"""Ring attention: exact attention over a sequence sharded across devices.
+
+Long-context support is net-new relative to the reference (it has no
+attention or sequence concept — SURVEY.md §5 "long-context"); this is the
+TPU-native design: the sequence axis is block-sharded over the mesh's ``sp``
+axis (one contiguous chunk per device), queries stay put, and K/V blocks
+rotate around the ``sp`` ring via ``ppermute`` — ICI neighbour exchange,
+overlappable with the per-step attention compute.  Each step folds one K/V
+block into a running online softmax (flash-attention style: running max
+``m``, denominator ``l``, numerator ``o`` — all f32), so the result is
+*exact* attention, independent of ring size up to float re-association.
+
+Two entry points:
+
+* ``ring_attention(q, k, v)`` — global [B, L, H, Dh] arrays; wraps the core
+  in a partial-manual ``shard_map`` (only ``sp`` manual, so ``dp``/``tp``
+  sharding of batch/heads stays under GSPMD control).  If the ambient mesh
+  already binds ``sp`` as manual (e.g. inside the pipeline stage body,
+  ``train.pipelined_blocks``), the arrays are per-device chunks and the core
+  runs directly — no nested manual computation, which XLA's Shardy
+  partitioner cannot transpose.
+* ``ring_attention_manual(q_c, k_c, v_c, sp=...)`` — the core itself, for
+  callers already inside an ``sp``-manual region.
+
+The backward pass is a hand-written second ring (``jax.custom_vjp``), the
+standard flash-attention backward: scores are recomputed per block from the
+saved log-sum-exp, and the dK/dV accumulators *travel with* their K/V blocks
+around the ring, arriving home after a full rotation.  Explicit rather than
+autodiff-derived so backward memory stays O(chunk) and the backward is plain
+forward-style collectives (transposing ``ppermute`` under Shardy's partial-
+manual mode is where autodiff breaks).
+
+Causal masking uses global positions reconstructed from the ring index
+(chunks are contiguous: device ``i`` holds positions ``[i*C, (i+1)*C)``).
+Fully-masked K/V blocks are still computed but contribute zero (the
+``-inf``-safe guards below); skipping them (striped/zigzag schedules) is a
+scheduling optimisation on top of the same kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+def _scores(q_c, k_cur, scale, causal, q_pos, k_pos):
+    """Masked f32 score block: [B, H, Lq, Lk]."""
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q_c, k_cur, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    return s
+
+
+def _online_softmax_step(o, m, l, s, v, dtype):
+    """Fold one score block into the running (o, m, l) accumulators.
+
+    o [B, Lq, H, Dh] f32, m/l [B, H, Lq] f32, s [B, H, Lq, Lk] f32 (masked
+    entries are -inf), v [B, Lk, H, Dh]."""
+    s_max = jnp.max(s, axis=-1)  # [B, H, Lq]
+    m_new = jnp.maximum(m, s_max)
+    # all-masked-so-far rows have m == m_new == -inf; keep them at zero
+    # weight without producing inf - inf = nan
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isneginf(s), 0.0, p)  # [B, H, Lq, Lk]
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        p.astype(dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+# ---------------------------------------------------------------------------
+# manual core (runs inside an sp-manual region; arrays are local chunks)
+# ---------------------------------------------------------------------------
+
+
+def _fwd_local(q_c, k_c, v_c, *, axis, sp, causal, scale):
+    dtype = q_c.dtype
+    ring_perm = [(i, (i + 1) % sp) for i in range(sp)]
+    B, C, H, Dh = q_c.shape
+    my = jax.lax.axis_index(axis)
+    q_pos = my * C + jnp.arange(C)
+
+    o = jnp.zeros((B, C, H, Dh), jnp.float32)
+    m = jnp.full((B, H, C), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, C), jnp.float32)
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        src = (my - i) % sp
+        s = _scores(q_c, k_cur, scale, causal, q_pos, src * C + jnp.arange(C))
+        o, m, l = _online_softmax_step(o, m, l, s, v_cur, dtype)
+        k_nxt = jax.lax.ppermute(k_cur, axis, ring_perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, ring_perm)
+        return o, m, l, k_nxt, v_nxt
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, sp, step, (o, m, l, k_c, v_c))
+    l_safe = jnp.where(l == 0.0, 1.0, l)  # all-masked rows -> zeros
+    out = (o / l_safe.transpose(0, 2, 1)[..., None]).astype(dtype)
+    lse = m + jnp.log(l_safe)  # -inf for all-masked rows
+    return out, lse
+
+
+def _bwd_local(q_c, k_c, v_c, o_c, lse_c, do_c, *, axis, sp, causal, scale):
+    """Second ring: dK/dV accumulators rotate WITH their K/V blocks and
+    arrive home after sp steps; dQ accumulates locally."""
+    dtype = q_c.dtype
+    ring_perm = [(i, (i + 1) % sp) for i in range(sp)]
+    B, C, H, Dh = q_c.shape
+    my = jax.lax.axis_index(axis)
+    q_pos = my * C + jnp.arange(C)
+    do32 = do_c.astype(jnp.float32)
+    # D = rowsum(dO * O): [B, H, Lq]
+    D = jnp.sum(do32 * o_c.astype(jnp.float32), axis=-1).transpose(0, 2, 1)
+    lse_safe = jnp.where(jnp.isneginf(lse_c), 0.0, lse_c)
+
+    dq = jnp.zeros((B, C, H, Dh), jnp.float32)
+    dk = jnp.zeros((B, C, H, Dh), jnp.float32)
+    dv = jnp.zeros((B, C, H, Dh), jnp.float32)
+
+    def step(i, carry):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (my - i) % sp
+        s = _scores(q_c, k_cur, scale, causal, q_pos, src * C + jnp.arange(C))
+        p = jnp.where(
+            jnp.isneginf(s), 0.0, jnp.exp(s - lse_safe[..., None])
+        )  # [B, H, Lq, Lk] f32
+        dv_cur = dv_cur + jnp.einsum(
+            "bhqk,bqhd->bkhd", p, do32, preferred_element_type=jnp.float32
+        )
+        dp = jnp.einsum(
+            "bqhd,bkhd->bhqk", do_c, v_cur, preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - D[..., None]) * scale
+        dq = dq + jnp.einsum(
+            "bhqk,bkhd->bqhd", ds, k_cur, preferred_element_type=jnp.float32
+        )
+        dk_cur = dk_cur + jnp.einsum(
+            "bhqk,bqhd->bkhd", ds, q_c, preferred_element_type=jnp.float32
+        )
+        k_nxt = jax.lax.ppermute(k_cur, axis, ring_perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, ring_perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis, ring_perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis, ring_perm)
+        return dq, k_nxt, v_nxt, dk_nxt, dv_nxt
+
+    dq, _, _, dk, dv = jax.lax.fori_loop(0, sp, step, (dq, k_c, v_c, dk, dv))
+    return dq.astype(dtype), dk.astype(dtype), dv.astype(dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _manual_core(axis: str, sp: int, causal: bool, scale: float):
+    """custom_vjp core over LOCAL chunks (cached so repeated traces reuse
+    one custom_vjp object and its rules)."""
+
+    @jax.custom_vjp
+    def core(q_c, k_c, v_c):
+        return _fwd_local(
+            q_c, k_c, v_c, axis=axis, sp=sp, causal=causal, scale=scale
+        )[0]
+
+    def core_fwd(q_c, k_c, v_c):
+        out, lse = _fwd_local(
+            q_c, k_c, v_c, axis=axis, sp=sp, causal=causal, scale=scale
+        )
+        return out, (q_c, k_c, v_c, out, lse)
+
+    def core_bwd(res, do):
+        q_c, k_c, v_c, out, lse = res
+        return _bwd_local(
+            q_c, k_c, v_c, out, lse, do,
+            axis=axis, sp=sp, causal=causal, scale=scale,
+        )
+
+    core.defvjp(core_fwd, core_bwd)
+    return core
+
+
+def ring_attention_manual(
+    q_c: jnp.ndarray,
+    k_c: jnp.ndarray,
+    v_c: jnp.ndarray,
+    sp: int,
+    causal: bool = True,
+    axis: str = "sp",
+) -> jnp.ndarray:
+    """Ring attention core for callers ALREADY inside an ``axis``-manual
+    region: q/k/v are this device's contiguous [B, C, H, Dh] chunks."""
+    scale = float(1.0 / np.sqrt(q_c.shape[-1]))
+    return _manual_core(axis, sp, causal, scale)(q_c, k_c, v_c)
+
+
+# ---------------------------------------------------------------------------
+# global entry
+# ---------------------------------------------------------------------------
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    axis: str = "sp",
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> jnp.ndarray:
+    """Exact attention over a globally [B, L, H, Dh] q/k/v, sequence-sharded
+    on ``axis``.  Returns [B, L, H, Dh] with q's dtype and sharding.
+
+    Chunks must be contiguous (standard block sharding) and positions the
+    plain ``0..L-1`` arange — RoPE or other positional transforms are the
+    caller's job (apply them *before*, on the globally-indexed arrays).
+
+    Inside a region where ``axis`` is already manual (pipeline stage body),
+    the inputs are local chunks and the core runs directly.
+    """
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return _unsharded_attention(q, k, v, causal)
+    sp = mesh.shape[axis]
+    if sp == 1:
+        return _unsharded_attention(q, k, v, causal)
+    axis_types = dict(zip(mesh.axis_names, mesh.axis_types))
+    if axis_types.get(axis) == jax.sharding.AxisType.Manual:
+        # already inside an sp-manual region: inputs are local chunks
+        return ring_attention_manual(q, k, v, sp, causal, axis)
+
+    scale = float(1.0 / np.sqrt(q.shape[-1]))
+    core = _manual_core(axis, sp, causal, scale)
+    spec = P(None, axis, None, None)
+    return jax.shard_map(
+        core,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis},
+        check_vma=False,
+    )(q, k, v)
+
+
+def full_attention(q, k, v, causal, positions_q=None, positions_k=None):
+    """The reference (non-ring) attention kernel: q [B, Lq, H, Dh],
+    k/v [B, Lk, H, Dh] (kv heads already repeated), f32 softmax, bf16
+    matmuls with f32 accumulation.  The single home of the numerics policy —
+    the transformer's full-attention path and the ring fallback both use it.
+
+    ``positions_*``: [B, L] absolute positions for the causal mask; defaults
+    to ``arange``."""
+    scale = np.float32(1.0 / np.sqrt(q.shape[-1]))  # f32: no x64 promotion
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        if positions_q is None:
+            mask = (
+                jnp.arange(q.shape[1])[:, None]
+                >= jnp.arange(k.shape[1])[None, :]
+            )[None, None]
+        else:
+            mask = (
+                positions_q[:, None, :, None] >= positions_k[:, None, None, :]
+            )
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+def _unsharded_attention(q, k, v, causal):
+    return full_attention(q, k, v, causal)

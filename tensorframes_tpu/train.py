@@ -1,0 +1,248 @@
+"""Training stack: sharded train step with dp/tp/sp/pp composition.
+
+Net-new relative to the reference (no training loop in-repo — SURVEY.md §5:
+model state is frozen into graphs as constants; iterative algorithms rebuild
+the graph per step).  The TPU-native design trains the flagship transformer
+with the full 4-axis mesh (``parallel.mesh.training_mesh``):
+
+* ``dp``/``tp``/``sp`` are sharding *constraints* inside the model
+  (``models/transformer.py``) — GSPMD inserts the all-reduces;
+* ``pp`` is a GPipe-style schedule implemented as a partial-manual
+  ``shard_map``: decoder blocks are stacked ``[n_layers, ...]`` and
+  re-grouped ``[S, n_layers/S, ...]`` with the stage axis sharded
+  ``P("pp")``; microbatches flow stage-to-stage around the ``pp`` ring via
+  ``ppermute``, the classic M+S-1-step pipeline.  The schedule is a
+  ``lax.scan`` (reverse-differentiable, so ``jax.grad`` runs the backward
+  pipeline in the same schedule, reversed).
+
+The optimizer is optax AdamW + global-norm clipping; optimizer state
+inherits the params' sharding under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from .models import transformer as tfm
+from .models.transformer import Params, TransformerConfig, shard
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    grad_clip: float = 1.0
+    pp_stages: int = 1  # pipeline stages (must divide n_layers)
+    microbatches: int = 1  # GPipe microbatches (must divide batch)
+
+
+# ---------------------------------------------------------------------------
+# pipelined forward
+# ---------------------------------------------------------------------------
+
+
+def _stage_params(blocks: Params, n_layers: int, stages: int) -> Params:
+    """[n_layers, ...] stacked blocks -> [stages, layers_per_stage, ...],
+    lead axis sharded over ``pp``."""
+    lps = n_layers // stages
+    regrouped = jax.tree_util.tree_map(
+        lambda a: a.reshape((stages, lps) + a.shape[1:]), blocks
+    )
+    return jax.tree_util.tree_map(
+        lambda a: shard(a, "pp", *([None] * (a.ndim - 1))), regrouped
+    )
+
+
+def pipelined_blocks(
+    blocks: Params,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    cfg: TransformerConfig,
+    stages: int,
+    microbatches: int,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> jnp.ndarray:
+    """Run the stacked decoder blocks as a ``stages``-deep GPipe pipeline
+    over the ``pp`` mesh axis.  x: [B, L, D]; batch is cut into
+    ``microbatches`` equal microbatches."""
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+    S, M = stages, microbatches
+    if cfg.n_layers % S:
+        raise ValueError(f"pp_stages {S} must divide n_layers {cfg.n_layers}")
+    B, L, D = x.shape
+    if B % M:
+        raise ValueError(f"microbatches {M} must divide batch {B}")
+    if (
+        S == 1
+        or mesh is None
+        or "pp" not in mesh.axis_names
+        or mesh.shape["pp"] == 1
+    ):
+        return tfm.apply_blocks(blocks, x, positions, cfg)
+    if mesh.shape["pp"] != S:
+        raise ValueError(
+            f"pp_stages={S} does not match the mesh's pp axis size "
+            f"{mesh.shape['pp']}; one pipeline stage per pp device"
+        )
+
+    mb = B // M
+    staged = _stage_params(blocks, cfg.n_layers, S)
+    x_mb = x.reshape(M, mb, L, D)
+    pos_mb = positions.reshape(M, mb, L)
+
+    # When the model uses ring attention and the mesh has an sp axis, the
+    # stage body is manual over BOTH pp and sp: the sequence dim arrives
+    # pre-chunked and ring_attention runs its already-manual core.  A nested
+    # sp-manual shard_map inside the pp-manual body would be untransposable
+    # (Shardy cannot differentiate nested manual computations).
+    manual = {"pp"}
+    seq_spec = None
+    if (
+        cfg.attn_impl == "ring"
+        and "sp" in mesh.axis_names
+        and mesh.shape["sp"] > 1
+    ):
+        manual.add("sp")
+        seq_spec = "sp"
+
+    def pp_body(x_mb, pos_mb, stage_blocks):
+        # stage_blocks arrive as [1, layers_per_stage, ...] (the device's
+        # slice of the pp-sharded stage axis) — drop the singleton
+        stage_blocks = jax.tree_util.tree_map(lambda a: a[0], stage_blocks)
+        M_, mb_, L_, D_ = x_mb.shape  # L_ is the sp-local chunk when manual
+        s = jax.lax.axis_index("pp")
+        is_first = s == 0
+        is_last = s == S - 1
+
+        buf = jnp.zeros((mb_, L_, D_), x_mb.dtype)
+        outs = jnp.zeros((M_, mb_, L_, D_), x_mb.dtype)
+        ring = [(i, (i + 1) % S) for i in range(S)]
+
+        def step(carry, t):
+            buf, outs = carry
+            t_in = jnp.clip(t, 0, M - 1)
+            fresh = jax.lax.dynamic_index_in_dim(
+                x_mb, t_in, 0, keepdims=False
+            )
+            pos = jax.lax.dynamic_index_in_dim(
+                pos_mb, t_in, 0, keepdims=False
+            )
+            inp = jnp.where(is_first, fresh, buf)
+            y = tfm.apply_blocks(stage_blocks, inp, pos, cfg)
+            # last stage emits microbatch t-(S-1) when it is in range
+            t_out = t - (S - 1)
+            emit = jnp.logical_and(is_last, t_out >= 0)
+            outs = jnp.where(
+                emit,
+                jax.lax.dynamic_update_index_in_dim(
+                    outs, y, jnp.clip(t_out, 0, M - 1), 0
+                ),
+                outs,
+            )
+            # rotate activations to the next stage (stage 0 receives the
+            # last stage's discard — overwritten by `fresh` next step)
+            buf = jax.lax.ppermute(y, "pp", ring)
+            return (buf, outs), None
+
+        (buf, outs), _ = jax.lax.scan(
+            step, (buf, outs), jnp.arange(M + S - 1)
+        )
+        # replicate the last stage's collected outputs across the ring
+        outs = jax.lax.psum(
+            jnp.where(is_last, outs, jnp.zeros_like(outs)), "pp"
+        )
+        return outs
+
+    outs = jax.shard_map(
+        pp_body,
+        mesh=mesh,
+        in_specs=(
+            P(None, None, seq_spec, None),
+            P(None, None, seq_spec),
+            P("pp"),
+        ),
+        out_specs=P(None, None, seq_spec, None),
+        axis_names=manual,
+        check_vma=False,
+    )(x_mb, pos_mb, staged)
+    return outs.reshape(B, L, D)
+
+
+def _pipeline_runner(tcfg: TrainConfig):
+    """A ``blocks_runner`` for ``transformer.apply``: the decoder stack as a
+    GPipe pipeline; embed/head stay outside (dp/tp-sharded, replicated over
+    pp)."""
+
+    def runner(blocks, x, positions, cfg):
+        return pipelined_blocks(
+            blocks, x, positions, cfg, tcfg.pp_stages, tcfg.microbatches
+        )
+
+    return runner
+
+
+def apply_pipelined(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    tcfg: TrainConfig,
+) -> jnp.ndarray:
+    return tfm.apply(
+        params, tokens, cfg, blocks_runner=_pipeline_runner(tcfg)
+    )
+
+
+def loss_pipelined(params, tokens, targets, cfg, tcfg):
+    return tfm.cross_entropy(
+        apply_pipelined(params, tokens, cfg, tcfg), targets
+    )
+
+
+# ---------------------------------------------------------------------------
+# optimizer / train step
+# ---------------------------------------------------------------------------
+
+
+def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(tcfg.grad_clip),
+        optax.adamw(
+            learning_rate=tcfg.learning_rate,
+            b1=tcfg.b1,
+            b2=tcfg.b2,
+            eps=tcfg.eps,
+            weight_decay=tcfg.weight_decay,
+        ),
+    )
+
+
+def make_train_step(cfg: TransformerConfig, tcfg: TrainConfig):
+    """Returns ``(train_step, tx)``; ``train_step(params, opt_state,
+    tokens, targets) -> (params, opt_state, loss)``, jitted.  Shard params
+    (``transformer.shard_params``) and batch before calling; GSPMD lays out
+    grads and optimizer state to match."""
+    tx = make_optimizer(tcfg)
+
+    def loss_fn(params, tokens, targets):
+        if tcfg.pp_stages > 1:
+            return loss_pipelined(params, tokens, targets, cfg, tcfg)
+        return tfm.loss_fn(params, tokens, targets, cfg)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step, tx

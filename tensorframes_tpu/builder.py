@@ -1,0 +1,189 @@
+"""OpBuilder: the fluent verb-builder protocol.
+
+Re-design of the reference's Py4J surface ``PythonOpBuilder``
+(``/root/reference/src/main/scala/org/tensorframes/impl/PythonInterface.scala:86-170``):
+the python client accumulates a graph (bytes or file path), shape hints,
+requested fetches, and a placeholder->column feed map, then dispatches
+``buildDF`` (frame-returning verbs) or ``buildRow`` (reducing verbs).  The
+reference needs this builder because every attribute crosses a Py4J socket;
+here there is no process boundary, but the protocol is kept as the stable
+programmatic surface mirroring ``map_blocks / map_rows / reduce_blocks /
+reduce_rows / aggregate_blocks`` (``PythonInterface.scala:46-68``) — the
+entry point an external front-end (e.g. a Spark bridge) would drive.
+
+    out = (OpBuilder.map_blocks(frame, trim=False)
+           .graph_from_file("model.pb")
+           .fetches(["out"])
+           .inputs({"x": "col"})
+           .shape("out", [-1, 10])
+           .build_df())
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from .frame import TensorFrame
+from .ops import engine
+from .ops.engine import Executor, GroupedFrame
+from .program import Program, ProgramError
+
+
+class OpBuilder:
+    """Accumulates program source + hints for one verb invocation.
+
+    Mirrors the reference builder's accessors: ``graph``/``graph_from_file``
+    (``PythonInterface.scala:110-118``), ``shape`` (L97-103), ``fetches``
+    (L105-108), ``inputs`` (L120-127), ``build_df``/``build_row``
+    (L129-151)."""
+
+    def __init__(
+        self,
+        verb: str,
+        frame: Any,
+        trim: bool = False,
+        engine_: Optional[Executor] = None,
+    ):
+        self._verb = verb
+        self._frame = frame
+        self._trim = trim
+        self._engine = engine_
+        self._source: Any = None  # callable | Program | GraphDef bytes/path
+        self._is_graphdef = False
+        self._fetches: Optional[List[str]] = None
+        self._feed: Dict[str, str] = {}
+        self._shapes: Dict[str, Sequence[int]] = {}
+
+    # -- verb factories (PythonInterface.scala:46-68) ------------------------
+
+    @staticmethod
+    def map_blocks(
+        frame: TensorFrame, trim: bool = False, engine_: Optional[Executor] = None
+    ) -> "OpBuilder":
+        return OpBuilder("map_blocks", frame, trim, engine_)
+
+    @staticmethod
+    def map_rows(
+        frame: TensorFrame, engine_: Optional[Executor] = None
+    ) -> "OpBuilder":
+        return OpBuilder("map_rows", frame, engine_=engine_)
+
+    @staticmethod
+    def reduce_blocks(
+        frame: TensorFrame, engine_: Optional[Executor] = None
+    ) -> "OpBuilder":
+        return OpBuilder("reduce_blocks", frame, engine_=engine_)
+
+    @staticmethod
+    def reduce_rows(
+        frame: TensorFrame, engine_: Optional[Executor] = None
+    ) -> "OpBuilder":
+        return OpBuilder("reduce_rows", frame, engine_=engine_)
+
+    @staticmethod
+    def aggregate_blocks(
+        grouped: GroupedFrame, engine_: Optional[Executor] = None
+    ) -> "OpBuilder":
+        return OpBuilder("aggregate", grouped, engine_=engine_)
+
+    # -- accumulators --------------------------------------------------------
+
+    def graph(self, source) -> "OpBuilder":
+        """Attach the program: a python function, a Program, DSL node(s), or
+        serialized GraphDef bytes."""
+        if isinstance(source, (bytes, bytearray)):
+            self._is_graphdef = True
+        self._source = source
+        return self
+
+    def graph_from_file(self, path: str) -> "OpBuilder":
+        """Attach a frozen GraphDef from a file path — the reference's
+        default transport (``core.py:38-49`` writes a temp file to avoid
+        shipping bytes through Py4J)."""
+        self._source = path
+        self._is_graphdef = True
+        return self
+
+    def fetches(self, names: Sequence[str]) -> "OpBuilder":
+        self._fetches = list(names)
+        return self
+
+    def inputs(self, feed: Mapping[str, str]) -> "OpBuilder":
+        """placeholder/input name -> frame column name."""
+        self._feed.update(feed)
+        return self
+
+    def shape(self, name: str, shape: Sequence[int]) -> "OpBuilder":
+        """Output-shape hint (the ``ShapeDescription`` override mechanism,
+        ``ShapeDescription.scala:3-16``)."""
+        self._shapes[name] = list(shape)
+        return self
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _program(self) -> Program:
+        if self._source is None:
+            raise ProgramError(
+                f"{self._verb} builder: no graph attached; call .graph(...) "
+                f"or .graph_from_file(...)"
+            )
+        if self._is_graphdef:
+            from .graphdef import import_graphdef
+
+            if not self._fetches:
+                raise ProgramError(
+                    "GraphDef programs need .fetches([...]) before build"
+                )
+            program = import_graphdef(
+                self._source,
+                fetches=self._fetches,
+                inputs=self._feed or None,
+            )
+        else:
+            program = Program.wrap(
+                self._source, self._fetches, self._feed or None
+            )
+        if self._shapes:
+            # shape hints are a validation overlay (ShapeDescription.scala):
+            # outputs named here must exist; concrete engine shapes win
+            known = program.fetches
+            if known is not None:
+                bad = sorted(set(self._shapes) - set(known))
+                if bad:
+                    raise ProgramError(
+                        f"shape hints for unknown outputs {bad}; program "
+                        f"outputs are {known}"
+                    )
+        return program
+
+    def build_df(self) -> TensorFrame:
+        """Run a frame-returning verb (``buildDF``,
+        ``PythonInterface.scala:144-151``)."""
+        program = self._program()
+        if self._verb == "map_blocks":
+            return engine.map_blocks(
+                program, self._frame, trim=self._trim, engine=self._engine
+            )
+        if self._verb == "map_rows":
+            return engine.map_rows(program, self._frame, engine=self._engine)
+        if self._verb == "aggregate":
+            return engine.aggregate(program, self._frame, engine=self._engine)
+        raise ProgramError(
+            f"{self._verb} returns a row, not a frame; use build_row()"
+        )
+
+    def build_row(self) -> Dict[str, np.ndarray]:
+        """Run a reducing verb to a single row (``buildRow``,
+        ``PythonInterface.scala:129-139``)."""
+        program = self._program()
+        if self._verb == "reduce_blocks":
+            return engine.reduce_blocks(
+                program, self._frame, engine=self._engine
+            )
+        if self._verb == "reduce_rows":
+            return engine.reduce_rows(program, self._frame, engine=self._engine)
+        raise ProgramError(
+            f"{self._verb} returns a frame, not a row; use build_df()"
+        )

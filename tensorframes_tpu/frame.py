@@ -65,6 +65,20 @@ class Column:
         return self.data[start:stop]
 
 
+def _py_cell_shape(c) -> Optional[Tuple[int, ...]]:
+    """Shape of a pure-python cell (scalar or nested list/tuple); None when
+    the cell is not plain python (e.g. an ndarray)."""
+    shape: List[int] = []
+    while isinstance(c, (list, tuple)):
+        if not c:
+            return None
+        shape.append(len(c))
+        c = c[0]
+    if isinstance(c, (bool, int, float)):
+        return tuple(shape)
+    return None
+
+
 def _column_from_cells(
     name: str, cells: List[Any], st: Optional[ScalarType] = None
 ) -> Column:
@@ -82,6 +96,21 @@ def _column_from_cells(
             arr[i] = c
         info = ColumnInfo(name, st, Shape((UNKNOWN,)))
         return Column(info, arr)
+    # fast path: pure-python cells -> one C++ pass into the final buffer
+    # (the TensorConverter/convertFast0 hot loop, SURVEY.md §7 hard part 3);
+    # ragged/mis-shaped cells raise inside the packer and fall back to the
+    # general path below, which handles them as a ragged column
+    cell_shape = _py_cell_shape(cells[0])
+    if cell_shape is not None:
+        from . import native
+
+        try:
+            packed = native.pack_cells(cells, cell_shape, st.np_dtype)
+        except ValueError:
+            packed = None
+        if packed is not None:
+            info = ColumnInfo(name, st, Shape(packed.shape).with_lead(UNKNOWN))
+            return Column(info, packed)
     np_cells = [np.asarray(c, dtype=st.np_dtype) for c in cells]
     rank = np_cells[0].ndim
     for i, c in enumerate(np_cells):

@@ -1,0 +1,330 @@
+"""Inception-v3 image scoring — the flagship benchmark model (config #4).
+
+The reference scores conv nets by freezing a TF checkpoint into a GraphDef
+and feeding JPEG bytes through ``tfs.map_rows``/``map_blocks``
+(``/root/reference/src/main/python/tensorframes_snippets/read_image.py:108-167``;
+its VGG flow is the same shape as the Inception flow named in
+BASELINE.json's north star).  Here the model is a native jax definition —
+NHWC convs on the MXU, bf16 compute with f32 accumulation — wrapped into a
+block program for ``map_blocks``; weights are Program-style closures, the
+TPU analog of "variables frozen into the graph".
+
+Architecture follows the standard Inception-v3 (googlenet v3) layout:
+stem convs -> 3x InceptionA -> B -> 4x InceptionC -> D -> 2x InceptionE ->
+global average pool -> logits.  BatchNorm is folded to inference form
+(scale/shift), as a frozen checkpoint would be.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+NUM_CLASSES = 1000
+INPUT_SIZE = 299  # [299, 299, 3] NHWC
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    w = jax.random.normal(key, (kh, kw, cin, cout), dtype) * np.sqrt(
+        2.0 / fan_in
+    ).astype(dtype)
+    # folded inference BatchNorm: y = conv(x) * scale + shift
+    return {
+        "w": w,
+        "scale": jnp.ones((cout,), dtype),
+        "shift": jnp.zeros((cout,), dtype),
+    }
+
+
+def _conv(p, x, stride=1, padding="SAME"):
+    y = jax.lax.conv_general_dilated(
+        x,
+        p["w"].astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    return jax.nn.relu(y * p["scale"].astype(x.dtype) + p["shift"].astype(x.dtype))
+
+
+def _avg_counts_1d(n: int, size: int, stride: int) -> np.ndarray:
+    """Per-output-position window population for SAME avg pooling (numpy,
+    trace-time constant — on-device reduce_window of a ones tensor makes XLA
+    constant-fold enormous arrays at compile time)."""
+    pad = max((int(np.ceil(n / stride)) - 1) * stride + size - n, 0)
+    lo = pad // 2
+    out = []
+    for o in range(int(np.ceil(n / stride))):
+        start = o * stride - lo
+        end = start + size
+        out.append(min(end, n) - max(start, 0))
+    return np.asarray(out, np.float32)
+
+
+def _pool(x, kind, size=3, stride=1, padding="SAME"):
+    if kind == "max":
+        return jax.lax.reduce_window(
+            x,
+            -jnp.inf,
+            jax.lax.max,
+            (1, size, size, 1),
+            (1, stride, stride, 1),
+            padding,
+        )
+    s = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1, size, size, 1), (1, stride, stride, 1), padding
+    )
+    if padding == "VALID":
+        return s / np.float32(size * size)
+    h, w = x.shape[1], x.shape[2]
+    counts = np.outer(
+        _avg_counts_1d(h, size, stride), _avg_counts_1d(w, size, stride)
+    )[None, :, :, None]
+    return s / jnp.asarray(counts, s.dtype)
+
+
+# branch spec: list of (kernel_h, kernel_w, cout, stride, padding)
+BranchSpec = List[Tuple[int, int, int, int, str]]
+
+
+def _branch_init(key, cin, spec: BranchSpec, dtype):
+    ps = []
+    for kh, kw, cout, _, _ in spec:
+        key, sub = jax.random.split(key)
+        ps.append(_conv_init(sub, kh, kw, cin, cout, dtype))
+        cin = cout
+    return ps
+
+
+def _branch_apply(ps, x, spec: BranchSpec):
+    for p, (_, _, _, stride, padding) in zip(ps, spec):
+        x = _conv(p, x, stride, padding)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# inception blocks — each returns (spec dict for init, apply fn)
+# ---------------------------------------------------------------------------
+
+
+def _block_specs(variant: str, cin: int, pool_ch: int = 0, c7: int = 0):
+    """Branch specs per Inception-v3 block variant."""
+    if variant == "A":
+        return {
+            "b1x1": [(1, 1, 64, 1, "SAME")],
+            "b5x5": [(1, 1, 48, 1, "SAME"), (5, 5, 64, 1, "SAME")],
+            "b3x3dbl": [
+                (1, 1, 64, 1, "SAME"),
+                (3, 3, 96, 1, "SAME"),
+                (3, 3, 96, 1, "SAME"),
+            ],
+            "pool": [(1, 1, pool_ch, 1, "SAME")],
+        }
+    if variant == "B":  # grid reduction 35 -> 17
+        return {
+            "b3x3": [(3, 3, 384, 2, "VALID")],
+            "b3x3dbl": [
+                (1, 1, 64, 1, "SAME"),
+                (3, 3, 96, 1, "SAME"),
+                (3, 3, 96, 2, "VALID"),
+            ],
+        }
+    if variant == "C":
+        return {
+            "b1x1": [(1, 1, 192, 1, "SAME")],
+            "b7x7": [
+                (1, 1, c7, 1, "SAME"),
+                (1, 7, c7, 1, "SAME"),
+                (7, 1, 192, 1, "SAME"),
+            ],
+            "b7x7dbl": [
+                (1, 1, c7, 1, "SAME"),
+                (7, 1, c7, 1, "SAME"),
+                (1, 7, c7, 1, "SAME"),
+                (7, 1, c7, 1, "SAME"),
+                (1, 7, 192, 1, "SAME"),
+            ],
+            "pool": [(1, 1, 192, 1, "SAME")],
+        }
+    if variant == "D":  # grid reduction 17 -> 8
+        return {
+            "b3x3": [(1, 1, 192, 1, "SAME"), (3, 3, 320, 2, "VALID")],
+            "b7x7x3": [
+                (1, 1, 192, 1, "SAME"),
+                (1, 7, 192, 1, "SAME"),
+                (7, 1, 192, 1, "SAME"),
+                (3, 3, 192, 2, "VALID"),
+            ],
+        }
+    if variant == "E":
+        return {
+            "b1x1": [(1, 1, 320, 1, "SAME")],
+            "b3x3_stem": [(1, 1, 384, 1, "SAME")],
+            "b3x3_a": [(1, 3, 384, 1, "SAME")],
+            "b3x3_b": [(3, 1, 384, 1, "SAME")],
+            "b3x3dbl_stem": [(1, 1, 448, 1, "SAME"), (3, 3, 384, 1, "SAME")],
+            "b3x3dbl_a": [(1, 3, 384, 1, "SAME")],
+            "b3x3dbl_b": [(3, 1, 384, 1, "SAME")],
+            "pool": [(1, 1, 192, 1, "SAME")],
+        }
+    raise ValueError(f"unknown block variant {variant}")
+
+
+def _block_init(key, variant, cin, dtype, pool_ch=0, c7=0):
+    specs = _block_specs(variant, cin, pool_ch, c7)
+    params = {}
+    for name, spec in specs.items():
+        key, sub = jax.random.split(key)
+        stem_cin = cin
+        if variant == "E" and name in ("b3x3_a", "b3x3_b"):
+            stem_cin = 384
+        if variant == "E" and name in ("b3x3dbl_a", "b3x3dbl_b"):
+            stem_cin = 384
+        params[name] = _branch_init(sub, stem_cin, spec, dtype)
+    return params
+
+
+def _block_apply(params, x, variant, pool_ch=0, c7=0):
+    cin = x.shape[-1]
+    specs = _block_specs(variant, cin, pool_ch, c7)
+    if variant in ("A", "C"):
+        outs = []
+        for name in [k for k in specs if k != "pool"]:
+            outs.append(_branch_apply(params[name], x, specs[name]))
+        pooled = _pool(x, "avg", 3, 1, "SAME")
+        outs.append(_branch_apply(params["pool"], pooled, specs["pool"]))
+        return jnp.concatenate(outs, axis=-1)
+    if variant in ("B", "D"):
+        outs = [
+            _branch_apply(params[name], x, specs[name]) for name in specs
+        ]
+        outs.append(_pool(x, "max", 3, 2, "VALID"))
+        return jnp.concatenate(outs, axis=-1)
+    # E: the 3x3 branches fork into parallel (1,3)/(3,1) halves
+    b1 = _branch_apply(params["b1x1"], x, specs["b1x1"])
+    stem = _branch_apply(params["b3x3_stem"], x, specs["b3x3_stem"])
+    b2 = jnp.concatenate(
+        [
+            _branch_apply(params["b3x3_a"], stem, specs["b3x3_a"]),
+            _branch_apply(params["b3x3_b"], stem, specs["b3x3_b"]),
+        ],
+        axis=-1,
+    )
+    stem2 = _branch_apply(params["b3x3dbl_stem"], x, specs["b3x3dbl_stem"])
+    b3 = jnp.concatenate(
+        [
+            _branch_apply(params["b3x3dbl_a"], stem2, specs["b3x3dbl_a"]),
+            _branch_apply(params["b3x3dbl_b"], stem2, specs["b3x3dbl_b"]),
+        ],
+        axis=-1,
+    )
+    pooled = _pool(x, "avg", 3, 1, "SAME")
+    b4 = _branch_apply(params["pool"], pooled, specs["pool"])
+    return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# full network
+# ---------------------------------------------------------------------------
+
+# (variant, kwargs) in order; cin is tracked by init/apply
+_BLOCKS = [
+    ("A", {"pool_ch": 32}),
+    ("A", {"pool_ch": 64}),
+    ("A", {"pool_ch": 64}),
+    ("B", {}),
+    ("C", {"c7": 128}),
+    ("C", {"c7": 160}),
+    ("C", {"c7": 160}),
+    ("C", {"c7": 192}),
+    ("D", {}),
+    ("E", {}),
+    ("E", {}),
+]
+
+_STEM = [  # (kh, kw, cout, stride, padding, then_maxpool)
+    (3, 3, 32, 2, "VALID", False),
+    (3, 3, 32, 1, "VALID", False),
+    (3, 3, 64, 1, "SAME", True),
+    (1, 1, 80, 1, "VALID", False),
+    (3, 3, 192, 1, "VALID", True),
+]
+
+
+def init(rng: jax.Array, dtype=jnp.bfloat16) -> Params:
+    params: Params = {"stem": [], "blocks": []}
+    cin = 3
+    for kh, kw, cout, _, _, _ in _STEM:
+        rng, sub = jax.random.split(rng)
+        params["stem"].append(_conv_init(sub, kh, kw, cin, cout, dtype))
+        cin = cout
+    # channel sizes after each block (standard v3): A:256,288,288; B:768;
+    # C:768 x4; D:1280; E:2048 x2
+    for variant, kw_ in _BLOCKS:
+        rng, sub = jax.random.split(rng)
+        params["blocks"].append(_block_init(sub, variant, cin, dtype, **kw_))
+        if variant == "A":
+            cin = 224 + kw_["pool_ch"]
+        elif variant == "B":
+            cin = cin + 384 + 96
+        elif variant == "C":
+            cin = 768
+        elif variant == "D":
+            cin = cin + 320 + 192
+        else:  # E
+            cin = 2048
+    rng, sub = jax.random.split(rng)
+    params["fc_w"] = jax.random.normal(
+        sub, (cin, NUM_CLASSES), dtype
+    ) * np.float32(np.sqrt(1.0 / cin))
+    params["fc_b"] = jnp.zeros((NUM_CLASSES,), dtype)
+    return params
+
+
+def apply(params: Params, images: jnp.ndarray) -> jnp.ndarray:
+    """images [N, 299, 299, 3] (float, ~[-1, 1]) -> logits [N, 1000]."""
+    x = images
+    for p, (_, _, _, stride, padding, then_pool) in zip(params["stem"], _STEM):
+        x = _conv(p, x, stride, padding)
+        if then_pool:
+            x = _pool(x, "max", 3, 2, "VALID")
+    for bp, (variant, kw_) in zip(params["blocks"], _BLOCKS):
+        x = _block_apply(bp, x, variant, **kw_)
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    return (
+        x @ params["fc_w"].astype(x.dtype) + params["fc_b"].astype(x.dtype)
+    ).astype(jnp.float32)
+
+
+def scoring_program(params: Params, dtype=jnp.bfloat16):
+    """Block program for ``map_blocks``: uint8 ``image`` [n, 299*299*3]
+    (or [n, 299, 299, 3]) -> top-1 ``prediction`` + ``score``.
+
+    Matches the reference flow: raw bytes in the frame, decode/normalise
+    inside the program (``read_image.py:164-167`` feeds JPEG bytes to an
+    in-graph decoder; fixed-size uint8 pixels are the XLA-friendly
+    equivalent — JPEG entropy decode stays on host, the documented Binary
+    limitation, ``datatypes.scala:571-622``)."""
+
+    def fn(image):
+        x = image.reshape(-1, INPUT_SIZE, INPUT_SIZE, 3)
+        x = x.astype(dtype) / np.float32(127.5) - np.float32(1.0)
+        logits = apply(params, x)
+        return {
+            "prediction": jnp.argmax(logits, axis=-1),
+            "score": jnp.max(jax.nn.log_softmax(logits, axis=-1), axis=-1),
+        }
+
+    return fn

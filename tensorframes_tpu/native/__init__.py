@@ -1,0 +1,61 @@
+"""Native (C++) data-plane kernels with a pure-numpy fallback.
+
+The reference's data plane is JVM+JNI: per-column ``TensorConverter``
+appenders (``datatypes.scala:93-127``) feeding ``tf.Tensor`` C buffers.
+Here the hot loop — python row cells -> one contiguous columnar buffer —
+is a small CPython extension (``packer.cpp``); everything downstream is a
+single ``device_put`` of that buffer.
+
+The extension is optional: ``pack_cells`` returns None when the module is
+not built (or the input doesn't fit the fast path) and the caller uses the
+numpy path.  Build with ``make -C tensorframes_tpu/native`` or
+``python -m tensorframes_tpu.native.build``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+try:  # the compiled extension is optional
+    from . import _native  # type: ignore
+except ImportError:  # pragma: no cover - exercised via fallback tests
+    _native = None
+
+# dtype -> packer.cpp DType code
+_DTYPE_CODES = {
+    np.dtype(np.float64): 0,
+    np.dtype(np.float32): 1,
+    np.dtype(np.int64): 2,
+    np.dtype(np.int32): 3,
+    np.dtype(np.uint8): 4,
+    np.dtype(np.bool_): 5,
+}
+
+
+def available() -> bool:
+    return _native is not None
+
+
+def pack_cells(
+    cells: Sequence,
+    cell_shape: Sequence[int],
+    dtype: np.dtype,
+) -> Optional[np.ndarray]:
+    """Pack uniform python row cells into one [n_rows, *cell_shape] array.
+
+    Returns None when the native module is absent or the dtype is not
+    supported — caller falls back to numpy.  Raises ValueError on ragged or
+    mis-shaped cells (strict, like the numpy path)."""
+    if _native is None:
+        return None
+    code = _DTYPE_CODES.get(np.dtype(dtype))
+    if code is None:
+        return None
+    cell_elems = 1
+    for d in cell_shape:
+        cell_elems *= int(d)
+    out = np.empty((len(cells),) + tuple(cell_shape), dtype=dtype)
+    _native.pack(cells, out.ctypes.data, cell_elems, code)
+    return out

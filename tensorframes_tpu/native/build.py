@@ -1,0 +1,40 @@
+"""Build the native extension in place: ``python -m tensorframes_tpu.native.build``.
+
+Uses the running interpreter's config (no setuptools project machinery —
+one translation unit, one .so next to this file)."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import sysconfig
+from pathlib import Path
+
+
+def build(verbose: bool = True) -> Path:
+    here = Path(__file__).resolve().parent
+    src = here / "packer.cpp"
+    ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = here / f"_native{ext}"
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++",
+        "-O3",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        f"-I{include}",
+        str(src),
+        "-o",
+        str(out),
+    ]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    print(f"built {path}")
+    sys.exit(0)

@@ -1,0 +1,165 @@
+// Row-cell -> contiguous columnar buffer packer.
+//
+// TPU-native equivalent of the reference's data-plane hot loops: the
+// per-column TensorConverter append path (reference
+// src/main/scala/org/tensorframes/impl/datatypes.scala:93-127) and the
+// unrolled convertFast0 (impl/DataOps.scala:63-81).  Those run on the JVM
+// per partition; here one C++ pass walks the python row cells (scalars or
+// nested sequences) and writes them straight into the numpy column buffer
+// the frame layer preallocated — no per-cell ndarray materialisation, no
+// np.stack copy.  The buffer is then device_put as a single contiguous
+// transfer (frame.py's columnar contract).
+//
+// Exposed as a tiny CPython extension (no numpy headers needed: the python
+// side passes the raw buffer address + element count).  The python wrapper
+// (native/__init__.py) falls back to the pure-numpy path when this module
+// is not built.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+
+namespace {
+
+enum DType : long {
+  DT_F64 = 0,
+  DT_F32 = 1,
+  DT_I64 = 2,
+  DT_I32 = 3,
+  DT_U8 = 4,
+  DT_BOOL = 5,
+};
+
+// Recursively flatten one cell (number or nested sequence) into out.
+// Returns the number of elements written, or -1 on error (python error set).
+template <typename T, bool kIsInt>
+Py_ssize_t fill_cell(PyObject* cell, T* out, Py_ssize_t capacity) {
+  if (PyFloat_Check(cell)) {
+    if (capacity < 1) {
+      PyErr_SetString(PyExc_ValueError, "cell has more elements than the column's cell shape");
+      return -1;
+    }
+    out[0] = static_cast<T>(PyFloat_AS_DOUBLE(cell));
+    return 1;
+  }
+  if (PyLong_Check(cell)) {
+    if (capacity < 1) {
+      PyErr_SetString(PyExc_ValueError, "cell has more elements than the column's cell shape");
+      return -1;
+    }
+    if (kIsInt) {
+      long long v = PyLong_AsLongLong(cell);
+      if (v == -1 && PyErr_Occurred()) return -1;
+      out[0] = static_cast<T>(v);
+    } else {
+      double v = PyLong_AsDouble(cell);
+      if (v == -1.0 && PyErr_Occurred()) return -1;
+      out[0] = static_cast<T>(v);
+    }
+    return 1;
+  }
+  if (PyBool_Check(cell)) {
+    if (capacity < 1) {
+      PyErr_SetString(PyExc_ValueError, "cell has more elements than the column's cell shape");
+      return -1;
+    }
+    out[0] = static_cast<T>(cell == Py_True ? 1 : 0);
+    return 1;
+  }
+  PyObject* fast = PySequence_Fast(cell, "cell must be a number or a sequence");
+  if (fast == nullptr) return -1;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject** items = PySequence_Fast_ITEMS(fast);
+  Py_ssize_t written = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    Py_ssize_t w = fill_cell<T, kIsInt>(items[i], out + written, capacity - written);
+    if (w < 0) {
+      Py_DECREF(fast);
+      return -1;
+    }
+    written += w;
+  }
+  Py_DECREF(fast);
+  return written;
+}
+
+template <typename T, bool kIsInt>
+PyObject* pack_typed(PyObject* rows, T* out, Py_ssize_t cell_elems) {
+  PyObject* fast = PySequence_Fast(rows, "rows must be a sequence");
+  if (fast == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject** items = PySequence_Fast_ITEMS(fast);
+  for (Py_ssize_t r = 0; r < n; r++) {
+    Py_ssize_t w = fill_cell<T, kIsInt>(items[r], out + r * cell_elems, cell_elems);
+    if (w < 0) {
+      Py_DECREF(fast);
+      return nullptr;
+    }
+    if (w != cell_elems) {
+      Py_DECREF(fast);
+      PyErr_Format(PyExc_ValueError,
+                   "row %zd has %zd elements, expected %zd (ragged cells "
+                   "cannot use the fast pack path)",
+                   r, w, cell_elems);
+      return nullptr;
+    }
+  }
+  Py_DECREF(fast);
+  Py_RETURN_NONE;
+}
+
+// pack(rows, buffer_addr, cell_elems, dtype_code)
+//
+// rows: sequence of cells (numbers or nested sequences, uniform shape)
+// buffer_addr: integer address of a preallocated C-contiguous buffer with
+//   len(rows) * cell_elems elements of the given dtype
+// cell_elems: elements per cell
+// dtype_code: DType enum above
+PyObject* pack(PyObject* /*self*/, PyObject* args) {
+  PyObject* rows;
+  unsigned long long addr;
+  Py_ssize_t cell_elems;
+  long dtype_code;
+  if (!PyArg_ParseTuple(args, "OKnl", &rows, &addr, &cell_elems, &dtype_code)) {
+    return nullptr;
+  }
+  if (cell_elems <= 0) {
+    PyErr_SetString(PyExc_ValueError, "cell_elems must be positive");
+    return nullptr;
+  }
+  void* out = reinterpret_cast<void*>(static_cast<uintptr_t>(addr));
+  switch (dtype_code) {
+    case DT_F64:
+      return pack_typed<double, false>(rows, static_cast<double*>(out), cell_elems);
+    case DT_F32:
+      return pack_typed<float, false>(rows, static_cast<float*>(out), cell_elems);
+    case DT_I64:
+      return pack_typed<int64_t, true>(rows, static_cast<int64_t*>(out), cell_elems);
+    case DT_I32:
+      return pack_typed<int32_t, true>(rows, static_cast<int32_t*>(out), cell_elems);
+    case DT_U8:
+      return pack_typed<uint8_t, true>(rows, static_cast<uint8_t*>(out), cell_elems);
+    case DT_BOOL:
+      return pack_typed<uint8_t, true>(rows, static_cast<uint8_t*>(out), cell_elems);
+    default:
+      PyErr_Format(PyExc_ValueError, "unknown dtype code %ld", dtype_code);
+      return nullptr;
+  }
+}
+
+PyMethodDef kMethods[] = {
+    {"pack", pack, METH_VARARGS,
+     "pack(rows, buffer_addr, cell_elems, dtype_code): flatten python row "
+     "cells into a preallocated contiguous column buffer"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "_native",
+    "tensorframes_tpu native data-plane kernels", -1, kMethods,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__native(void) { return PyModule_Create(&kModule); }

@@ -1,0 +1,71 @@
+"""Native data-plane packer: parity with the numpy fallback path.
+
+The C++ packer (native/packer.cpp) is the TensorConverter/convertFast0
+equivalent (reference ``datatypes.scala:93-127``, ``DataOps.scala:63-81``);
+these tests pin its semantics to the pure-numpy path so either build mode
+produces identical frames.
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import native
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native extension not built"
+)
+
+
+@needs_native
+@pytest.mark.parametrize(
+    "dtype", [np.float64, np.float32, np.int64, np.int32, np.uint8, np.bool_]
+)
+def test_pack_scalar_cells_all_dtypes(dtype):
+    vals = [1, 0, 1] if dtype == np.bool_ else [1, 2, 3]
+    out = native.pack_cells(vals, (), dtype)
+    np.testing.assert_array_equal(out, np.asarray(vals, dtype))
+    assert out.dtype == dtype
+
+
+@needs_native
+def test_pack_nested_cells():
+    cells = [[[1.0, 2.0], [3.0, 4.0]], [[5.0, 6.0], [7.0, 8.0]]]
+    out = native.pack_cells(cells, (2, 2), np.float64)
+    np.testing.assert_array_equal(out, np.asarray(cells))
+
+
+@needs_native
+def test_pack_mixed_int_float_coerces():
+    out = native.pack_cells([[1, 2.5], [3, 4]], (2,), np.float64)
+    np.testing.assert_array_equal(out, [[1.0, 2.5], [3.0, 4.0]])
+
+
+@needs_native
+def test_pack_ragged_raises():
+    with pytest.raises(ValueError):
+        native.pack_cells([[1.0], [2.0, 3.0]], (1,), np.float64)
+    with pytest.raises(ValueError):
+        native.pack_cells([[1.0, 2.0], [3.0]], (2,), np.float64)
+
+
+def test_from_rows_native_and_fallback_agree(monkeypatch):
+    rows = [{"x": float(i), "v": [1.0 * i, 2.0 * i]} for i in range(10)]
+    tf_fast = tfs.TensorFrame.from_rows(rows, num_blocks=2)
+    # force the numpy path
+    monkeypatch.setattr(native, "_native", None)
+    tf_slow = tfs.TensorFrame.from_rows(rows, num_blocks=2)
+    for name in ("x", "v"):
+        np.testing.assert_array_equal(
+            tf_fast.column(name).data, tf_slow.column(name).data
+        )
+        assert (
+            tf_fast.column(name).data.dtype == tf_slow.column(name).data.dtype
+        )
+    assert repr(tf_fast.schema.explain()) == repr(tf_slow.schema.explain())
+
+
+def test_ragged_rows_still_become_ragged_column():
+    rows = [{"v": [1.0]}, {"v": [2.0, 3.0]}]
+    tf = tfs.TensorFrame.from_rows(rows)
+    assert tf.column("v").is_ragged

@@ -9,6 +9,7 @@ specified for (``Operations.scala:110-126``).
 Run: ``python examples/geom_mean.py``
 """
 
+import jax.numpy as jnp
 import numpy as np
 
 import tensorframes_tpu as tfs
@@ -17,7 +18,7 @@ import tensorframes_tpu as tfs
 def grouped_geometric_mean(frame: tfs.TensorFrame, key: str, col: str):
     """Returns a TensorFrame [key, gmean] with one row per key."""
     logged = tfs.map_blocks(
-        lambda x: {"log_x": np.log(1.0) + __import__("jax.numpy", fromlist=["log"]).log(x), "one": x * 0.0 + 1.0},
+        lambda x: {"log_x": jnp.log(x), "one": jnp.ones_like(x)},
         frame,
         feed_dict={"x": col},
     )

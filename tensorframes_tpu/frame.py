@@ -106,7 +106,9 @@ def _column_from_cells(
 
         try:
             packed = native.pack_cells(cells, cell_shape, st.np_dtype)
-        except ValueError:
+        except (ValueError, TypeError):
+            # any packer rejection (ragged, mis-shaped, non-plain-python
+            # cells) routes to the general numpy path below
             packed = None
         if packed is not None:
             info = ColumnInfo(name, st, Shape(packed.shape).with_lead(UNKNOWN))

@@ -46,16 +46,15 @@ def pack_cells(
     """Pack uniform python row cells into one [n_rows, *cell_shape] array.
 
     Returns None when the native module is absent or the dtype is not
-    supported — caller falls back to numpy.  Raises ValueError on ragged or
-    mis-shaped cells (strict, like the numpy path)."""
+    supported — caller falls back to numpy.  Raises ValueError on ragged,
+    mis-shaped, or non-plain-python cells (strict: nesting depth and
+    per-level lengths are verified against ``cell_shape``)."""
     if _native is None:
         return None
     code = _DTYPE_CODES.get(np.dtype(dtype))
     if code is None:
         return None
-    cell_elems = 1
-    for d in cell_shape:
-        cell_elems *= int(d)
-    out = np.empty((len(cells),) + tuple(cell_shape), dtype=dtype)
-    _native.pack(cells, out.ctypes.data, cell_elems, code)
+    shape = tuple(int(d) for d in cell_shape)
+    out = np.empty((len(cells),) + shape, dtype=dtype)
+    _native.pack(cells, out.ctypes.data, shape, code)
     return out

@@ -69,3 +69,34 @@ def test_ragged_rows_still_become_ragged_column():
     rows = [{"v": [1.0]}, {"v": [2.0, 3.0]}]
     tf = tfs.TensorFrame.from_rows(rows)
     assert tf.column("v").is_ragged
+
+
+@needs_native
+def test_pack_str_cell_raises_cleanly():
+    # regression: a str cell is a sequence containing itself; the packer must
+    # reject it with ValueError instead of recursing without bound (SIGSEGV)
+    with pytest.raises(ValueError):
+        native.pack_cells([[1, 2], ["a", "b"]], (2,), np.float64)
+    with pytest.raises(ValueError):
+        native.pack_cells(["ab", "cd"], (2,), np.float64)
+    with pytest.raises(ValueError):
+        native.pack_cells([b"ab", b"cd"], (2,), np.float64)
+
+
+@needs_native
+def test_pack_structure_validated_not_just_count():
+    # regression: a flat row with the right element count but wrong nesting
+    # must be rejected (was silently reinterpreted as the cell shape)
+    with pytest.raises(ValueError):
+        native.pack_cells([[[1, 2], [3, 4]], [1, 2, 3, 4]], (2, 2), np.float64)
+    with pytest.raises(ValueError):
+        native.pack_cells([[1, 2, 3, 4]], (2, 2), np.float64)
+
+
+def test_mixed_python_numpy_cells_fall_back_to_numpy_path():
+    # regression: np scalar leaves raise inside the packer; the frame layer
+    # must route them to the numpy path, not propagate the error
+    from tensorframes_tpu.frame import _column_from_cells
+
+    col = _column_from_cells("x", [[1, 2], np.array([3, 4])])
+    np.testing.assert_array_equal(np.asarray(col.data), [[1, 2], [3, 4]])

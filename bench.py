@@ -1,25 +1,43 @@
-"""Headline benchmark: ``map_blocks`` model-scoring throughput (rows/sec).
+"""Headline benchmark: Inception-v3 ``map_blocks`` image scoring (rows/sec).
 
-This is BASELINE.json's primary metric family — block model scoring via
-``tfs.map_blocks`` (the reference's frozen-graph image-scoring path,
-``read_image.py:108-167``; its per-partition CPU TF sessions are the baseline
-being replaced).  Input rows are uint8 image vectors, normalised on device —
-the reference likewise ships raw bytes and decodes/casts inside the graph
-(``read_image.py:164-167``), keeping host->device traffic at 1 byte/pixel.
+This is BASELINE.md's north-star config #4 — frozen-model image scoring over
+ImageNet-shaped rows through ``tfs.map_blocks``, the reference's flagship
+workload (``/root/reference/src/main/python/tensorframes_snippets/read_image.py:108-167``:
+frozen GraphDef + per-partition CPU TF sessions).  Input rows are raw uint8
+pixels ([299, 299, 3] = 268 KB/row, 1 byte/pixel host->device), normalised
+and scored inside the program, exactly like the reference feeds raw bytes and
+decodes/casts in-graph (``read_image.py:164-167``).
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
-measured directly: the identical scoring computation run through NumPy/BLAS on
-the host CPU — the stand-in for the reference's CPU-TF data plane.
+measured directly: the identical Inception-v3 scoring computation compiled by
+XLA for the host CPU (multi-threaded) — the stand-in for the reference's CPU
+TF data plane, and a *stronger* baseline than its row-at-a-time JNI path.
+The CPU runs f32 (its fastest precision); the TPU runs the bf16-with-f32-
+accumulation policy the framework uses for MXU matmuls.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line with the required keys {"metric", "value", "unit",
+"vs_baseline"} plus diagnostic extras (achieved TFLOP/s, MFU, phase
+breakdown — VERDICT.md round-1 items 1 and 9).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
+
+# bf16 peak FLOP/s per chip by device kind (public spec sheets); used only
+# for the diagnostic MFU figure, never for the headline metric.
+_PEAK_BF16 = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
 
 
 def _timeit(fn, reps: int, warmup: int) -> float:
@@ -35,62 +53,160 @@ def _timeit(fn, reps: int, warmup: int) -> float:
 
 def main() -> None:
     import jax
+
+    # persistent XLA executable cache: first-ever compile of Inception over a
+    # remote TPU link costs minutes; every later bench run deserialises it
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".cache", "jax"
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     import jax.numpy as jnp
 
     import tensorframes_tpu as tfs
-    from tensorframes_tpu.models import mlp
+    from tensorframes_tpu.models import inception
 
-    n_rows = 65_536
-    features = 784
-    layers = [features, 2048, 2048, 2048, 1024, 10]
+    n_rows = 512
+    num_blocks = 4  # multiple blocks exercise the overlapped data plane
+    block_rows = n_rows // num_blocks
+    side = inception.INPUT_SIZE
 
     rng = np.random.RandomState(0)
-    images = rng.randint(0, 256, size=(n_rows, features), dtype=np.uint8)
-    params = mlp.init(jax.random.PRNGKey(0), layers, dtype=jnp.float32)
-    frame = tfs.TensorFrame.from_arrays({"image": images}, num_blocks=1)
-
-    def score(image):
-        x = image.astype(jnp.float32) / 255.0
-        logits = mlp.apply(params, x)
-        return {"prediction": jnp.argmax(logits, axis=-1)}
+    images = rng.randint(
+        0, 256, size=(n_rows, side, side, 3), dtype=np.uint8
+    )
+    params = inception.init(0, dtype=jnp.bfloat16)  # host numpy, no dispatch
+    frame = tfs.TensorFrame.from_arrays(
+        {"image": images}, num_blocks=num_blocks
+    )
 
     # wrap once: the Program's jit cache persists across reps (SURVEY.md P6)
-    program = tfs.Program.wrap(score, fetches=["prediction"])
+    program = tfs.Program.wrap(
+        inception.scoring_program(params, dtype=jnp.bfloat16),
+        fetches=["prediction", "score"],
+    )
 
-    def run_tpu():
-        out = tfs.map_blocks(program, frame)
+    def run_once(fr):
+        out = tfs.map_blocks(program, fr)
+        # materialise: the verbs are fully async, so the clock must include
+        # the device->host readback of the (tiny) per-row outputs
         np.asarray(out.column("prediction").data)
+        np.asarray(out.column("score").data)
 
-    tpu_s = _timeit(run_tpu, reps=3, warmup=1)
+    # cold pass: compile (persistent-cached) + host->HBM transfer included
+    t0 = time.perf_counter()
+    run_once(frame)
+    cold_s = time.perf_counter() - t0
+
+    # steady state: the frame cached in HBM (tfs .cache(), the Spark
+    # df.cache() analog the reference demos use before iterating) — scoring
+    # reads inputs from device memory, the TPU-native operating point
+    frame = frame.cache()
+    tpu_s = _timeit(lambda: run_once(frame), reps=3, warmup=1)
     rows_per_s = n_rows / tpu_s
 
-    # NumPy/BLAS oracle of the identical computation on a subset, scaled —
-    # the CPU data-plane stand-in for the reference's per-partition TF run.
-    np_params = [
-        {k: np.asarray(v) for k, v in layer.items()} for layer in params
-    ]
-    sub = images[:4096]
-
-    def run_cpu():
-        h = sub.astype(np.float32) / 255.0
-        for layer in np_params[:-1]:
-            h = np.maximum(h @ layer["w"] + layer["b"], 0.0)
-        logits = h @ np_params[-1]["w"] + np_params[-1]["b"]
-        logits.argmax(-1)
-
-    cpu_s = _timeit(run_cpu, reps=2, warmup=1) * (n_rows / len(sub))
-    baseline_rows_per_s = n_rows / cpu_s
-
-    print(
-        json.dumps(
-            {
-                "metric": "map_blocks model-scoring throughput",
-                "value": round(rows_per_s, 1),
-                "unit": "rows/sec/chip",
-                "vs_baseline": round(rows_per_s / baseline_rows_per_s, 2),
-            }
-        )
+    # -- analytic FLOP count from XLA cost analysis ------------------------
+    flops_per_block = None
+    try:
+        lowered = jax.jit(
+            inception.scoring_program(params, dtype=jnp.bfloat16)
+        ).lower(images[:block_rows])
+        ca = None
+        try:
+            ca = lowered.cost_analysis()
+        except Exception:
+            ca = None
+        if not (ca and "flops" in (ca[0] if isinstance(ca, (list, tuple)) else ca)):
+            # executable-level analysis; cheap — the compile is served from
+            # the persistent cache warmed by the run above
+            ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        if ca and "flops" in ca:
+            flops_per_block = float(ca["flops"])
+    except Exception:
+        pass
+    tflops = (
+        flops_per_block * num_blocks / tpu_s / 1e12
+        if flops_per_block
+        else None
     )
+    kind = jax.devices()[0].device_kind
+    peak = _PEAK_BF16.get(kind)
+    mfu = (tflops * 1e12 / peak) if (tflops and peak) else None
+
+    # -- phase breakdown (one rep, reusing the Program's executable) ---------
+    phases = {}
+    try:
+        blk = images[:block_rows]
+        t0 = time.perf_counter()
+        dev = jax.device_put(blk)
+        dev.block_until_ready()
+        phases["h2d_s_per_block"] = round(time.perf_counter() - t0, 4)
+        jit_fn = program.jitted()
+        t0 = time.perf_counter()
+        outs = jit_fn({"image": dev})
+        outs["prediction"].block_until_ready()
+        phases["compute_s_per_block"] = round(time.perf_counter() - t0, 4)
+        t0 = time.perf_counter()
+        np.asarray(outs["prediction"]), np.asarray(outs["score"])
+        phases["d2h_s_per_block"] = round(time.perf_counter() - t0, 4)
+    except Exception:
+        pass
+
+    # -- CPU baseline: identical computation, XLA-compiled for the host ----
+    # (subset scaled up; f32 — the CPU's fastest precision)
+    cpu_rows = 8
+    sub = images[:cpu_rows]
+    try:
+        cpu = jax.devices("cpu")[0]
+        cpu_params = jax.tree.map(
+            lambda a: np.asarray(a, np.float32), params
+        )
+        with jax.default_device(cpu):
+            cpu_fn = jax.jit(
+                inception.scoring_program(cpu_params, dtype=jnp.float32)
+            )
+            cpu_sub = jax.device_put(sub, cpu)
+
+            def run_cpu():
+                outs = cpu_fn(cpu_sub)
+                np.asarray(outs["prediction"])
+
+            cpu_s = _timeit(run_cpu, reps=2, warmup=1) * (n_rows / cpu_rows)
+    except Exception:
+        cpu_s = float("nan")
+
+    import math
+
+    if math.isfinite(cpu_s) and cpu_s > 0:
+        baseline_rows_per_s = n_rows / cpu_s
+        vs_baseline = round(rows_per_s / baseline_rows_per_s, 2)
+        baseline_desc = (
+            f"XLA-CPU Inception-v3 f32 ({baseline_rows_per_s:.2f} rows/sec)"
+        )
+    else:  # keep the output line strict JSON even if the CPU path breaks
+        vs_baseline = None
+        baseline_desc = "unavailable (CPU baseline failed)"
+
+    result = {
+        "metric": "map_blocks Inception-v3 scoring throughput (HBM-cached frame)",
+        "value": round(rows_per_s, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": vs_baseline,
+        "device": kind,
+        "baseline": baseline_desc,
+        "cold_rows_per_s": round(n_rows / cold_s, 1),
+    }
+    if tflops is not None:
+        result["achieved_tflops"] = round(tflops, 2)
+    if mfu is not None:
+        result["mfu"] = round(mfu, 4)
+    if phases:
+        result["phases"] = phases
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

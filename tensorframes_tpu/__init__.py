@@ -20,6 +20,7 @@ The user-facing module mirrors the reference's python API
 
 from . import dsl
 from .analyze import analyze, explain, print_schema
+from .builder import OpBuilder
 from .dsl import block, row
 from .dtypes import ScalarType, by_name as scalar_type, supported_types
 from .frame import TensorFrame
@@ -50,6 +51,7 @@ __all__ = [
     "dsl",
     "block",
     "row",
+    "OpBuilder",
     "analyze",
     "explain",
     "print_schema",

@@ -55,6 +55,7 @@ class OpBuilder:
         self._fetches: Optional[List[str]] = None
         self._feed: Dict[str, str] = {}
         self._shapes: Dict[str, Sequence[int]] = {}
+        self._host_stage: Dict[str, Any] = {}
 
     # -- verb factories (PythonInterface.scala:46-68) ------------------------
 
@@ -121,6 +122,13 @@ class OpBuilder:
         self._shapes[name] = list(shape)
         return self
 
+    def host_stage(self, input_name: str, fn) -> "OpBuilder":
+        """Attach a host preprocessing fn for one input (binary decode —
+        the host half of the reference's in-graph DecodeJpeg feed,
+        ``read_image.py:164-167``)."""
+        self._host_stage[input_name] = fn
+        return self
+
     # -- dispatch ------------------------------------------------------------
 
     def _program(self) -> Program:
@@ -146,16 +154,10 @@ class OpBuilder:
                 self._source, self._fetches, self._feed or None
             )
         if self._shapes:
-            # shape hints are a validation overlay (ShapeDescription.scala):
-            # outputs named here must exist; concrete engine shapes win
-            known = program.fetches
-            if known is not None:
-                bad = sorted(set(self._shapes) - set(known))
-                if bad:
-                    raise ProgramError(
-                        f"shape hints for unknown outputs {bad}; program "
-                        f"outputs are {known}"
-                    )
+            # the ShapeDescription override: hints refine engine-inferred
+            # shapes in analyze() and are checked against real outputs at
+            # run time (contradictions raise)
+            program = program.with_shape_hints(self._shapes)
         return program
 
     def build_df(self) -> TensorFrame:
@@ -164,11 +166,26 @@ class OpBuilder:
         program = self._program()
         if self._verb == "map_blocks":
             return engine.map_blocks(
-                program, self._frame, trim=self._trim, engine=self._engine
+                program,
+                self._frame,
+                trim=self._trim,
+                host_stage=self._host_stage or None,
+                engine=self._engine,
             )
         if self._verb == "map_rows":
-            return engine.map_rows(program, self._frame, engine=self._engine)
+            return engine.map_rows(
+                program,
+                self._frame,
+                host_stage=self._host_stage or None,
+                engine=self._engine,
+            )
         if self._verb == "aggregate":
+            if self._host_stage:
+                raise ProgramError(
+                    "host_stage is only supported on the map verbs "
+                    "(map_blocks/map_rows); preprocess with a map first, "
+                    "then aggregate the result"
+                )
             return engine.aggregate(program, self._frame, engine=self._engine)
         raise ProgramError(
             f"{self._verb} returns a row, not a frame; use build_row()"
@@ -177,6 +194,12 @@ class OpBuilder:
     def build_row(self) -> Dict[str, np.ndarray]:
         """Run a reducing verb to a single row (``buildRow``,
         ``PythonInterface.scala:129-139``)."""
+        if self._host_stage:
+            raise ProgramError(
+                "host_stage is only supported on the map verbs "
+                "(map_blocks/map_rows); preprocess with a map first, then "
+                "reduce the result"
+            )
         program = self._program()
         if self._verb == "reduce_blocks":
             return engine.reduce_blocks(

@@ -124,7 +124,9 @@ def from_numpy(dtype) -> ScalarType:
     """Lookup by numpy dtype (reference ``getOps`` by SQL type,
     ``datatypes.scala:275-281``)."""
     dt = np.dtype(dtype)
-    if dt == np.dtype(object):
+    if dt == np.dtype(object) or dt.kind in "SU":
+        # object cells and numpy fixed-width bytes/str are both host-only
+        # binary (np.asarray over a list of python bytes yields kind 'S')
         return binary
     st = _BY_NP.get(dt)
     if st is None:

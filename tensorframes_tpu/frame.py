@@ -28,6 +28,18 @@ from .schema import ColumnInfo, Schema, SchemaError
 from .shape import UNKNOWN, Shape
 
 
+def is_device_array(x) -> bool:
+    """True for a jax array (device-resident column storage).
+
+    Verb outputs stay on device (``jax.Array``) so chained verbs never
+    round-trip through the host — the overlap design SURVEY.md §7 hard part 3
+    calls for.  Host materialisation happens lazily at ``collect``/
+    ``to_arrays``/``np.asarray`` time."""
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
 def _is_ragged(cells: Sequence[np.ndarray]) -> bool:
     if not cells:
         return False
@@ -47,18 +59,25 @@ class Column:
     """
 
     info: ColumnInfo
-    data: Any  # np.ndarray | List[np.ndarray]
+    data: Any  # np.ndarray | jax.Array (device-resident) | List[np.ndarray]
 
     @property
     def is_ragged(self) -> bool:
-        return not isinstance(self.data, np.ndarray) or self.data.dtype == object
+        if isinstance(self.data, np.ndarray):
+            return self.data.dtype == object
+        return not is_device_array(self.data)
+
+    @property
+    def is_device(self) -> bool:
+        """Whether the column currently lives in device memory (HBM)."""
+        return is_device_array(self.data)
 
     def num_rows(self) -> int:
         return len(self.data)
 
     def cells(self) -> List[np.ndarray]:
-        if isinstance(self.data, np.ndarray) and self.data.dtype != object:
-            return list(self.data)
+        if is_device_array(self.data):
+            return list(np.asarray(self.data))
         return list(self.data)
 
     def slice(self, start: int, stop: int) -> Any:
@@ -238,20 +257,32 @@ class TensorFrame:
             offsets.append(offsets[-1] + len(next(iter(b.values()))))
         cols = []
         for name in names:
-            parts = [np.asarray(b[name]) for b in blocks]
+            parts = [b[name] for b in blocks]
+            on_device = all(is_device_array(p) for p in parts)
+            if not on_device:
+                parts = [np.asarray(p) for p in parts]
             ranks = {p.ndim for p in parts}
             if len(ranks) != 1:
                 raise SchemaError(f"column {name!r}: blocks disagree on rank")
             cell_shapes = {p.shape[1:] for p in parts}
-            if len(cell_shapes) == 1 and parts[0].dtype != object:
-                data = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            if len(cell_shapes) == 1 and (on_device or parts[0].dtype != object):
+                if len(parts) > 1:
+                    if on_device:
+                        # concat on device: no host round-trip between verbs
+                        import jax.numpy as jnp
+
+                        data = jnp.concatenate(parts)
+                    else:
+                        data = np.concatenate(parts)
+                else:
+                    data = parts[0]
                 st = dtypes.from_numpy(data.dtype)
                 info = ColumnInfo(name, st, Shape(data.shape).with_lead(UNKNOWN))
                 cols.append(Column(info, data))
             else:
                 cells: List[np.ndarray] = []
                 for p in parts:
-                    cells.extend(list(p))
+                    cells.extend(list(np.asarray(p)))
                 cols.append(_column_from_cells(name, cells))
         return TensorFrame(cols, offsets)
 
@@ -337,6 +368,46 @@ class TensorFrame:
     def select(self, names: Sequence[str]) -> "TensorFrame":
         return TensorFrame([self.column(n) for n in names], self._offsets)
 
+    def cache(self, device=None) -> "TensorFrame":
+        """Pin device-feedable columns in device memory (HBM).
+
+        The Spark ``df.cache()`` analog (the reference's demos cache the
+        DataFrame before iterating, ``kmeans_demo.py``), but TPU-shaped: one
+        async ``device_put`` per column, after which every verb reads the
+        column from HBM with zero host->device traffic.  Columns are
+        immutable, so the cached copy can never go stale.
+
+        Stays on host: binary and ragged columns (host inputs by
+        definition), and 64-bit columns when jax runs without x64 — caching
+        those would silently truncate the stored values (device_put
+        canonicalises to 32-bit) while the schema still claims 64; the host
+        copy remains authoritative and verbs keep casting per block.  Cast
+        the column to a 32-bit dtype first to cache it."""
+        import jax
+
+        cols = []
+        for c in self._columns:
+            st = c.info.scalar_type
+            if (
+                c.is_device
+                or c.is_ragged
+                or not st.device_ok
+                or dtypes.coerce(st) is not st
+            ):
+                cols.append(c)
+            else:
+                data = jax.device_put(c.data, device)
+                cols.append(Column(c.info, data))
+        return TensorFrame(cols, self._offsets)
+
+    def uncache(self) -> "TensorFrame":
+        """Materialise device-resident columns back to host numpy."""
+        cols = [
+            Column(c.info, np.asarray(c.data)) if c.is_device else c
+            for c in self._columns
+        ]
+        return TensorFrame(cols, self._offsets)
+
     def group_by(self, *keys: str):
         """Group rows by key columns for ``aggregate`` (Spark ``groupBy``)."""
         from .ops.engine import GroupedFrame
@@ -369,6 +440,8 @@ class TensorFrame:
         for c in self._columns:
             if c.is_ragged or c.info.cell_shape.rank > 0:
                 data[c.info.name] = c.cells()
+            elif c.is_device:
+                data[c.info.name] = np.asarray(c.data)
             else:
                 data[c.info.name] = c.data
         return pd.DataFrame(data)

@@ -36,14 +36,16 @@ INPUT_SIZE = 299  # [299, 299, 3] NHWC
 
 def _conv_init(key, kh, kw, cin, cout, dtype):
     fan_in = kh * kw * cin
-    w = jax.random.normal(key, (kh, kw, cin, cout), dtype) * np.sqrt(
-        2.0 / fan_in
-    ).astype(dtype)
+    # host-side numpy init (He-normal): params stay numpy until the jitted
+    # scoring program captures them, so construction costs ZERO device
+    # dispatches — a jax.random draw per conv (~190 of them) costs seconds
+    # of pure dispatch latency on a remote/tunneled TPU
+    w = (key.randn(kh, kw, cin, cout) * np.sqrt(2.0 / fan_in)).astype(dtype)
     # folded inference BatchNorm: y = conv(x) * scale + shift
     return {
         "w": w,
-        "scale": jnp.ones((cout,), dtype),
-        "shift": jnp.zeros((cout,), dtype),
+        "scale": np.ones((cout,), dtype),
+        "shift": np.zeros((cout,), dtype),
     }
 
 
@@ -102,8 +104,7 @@ BranchSpec = List[Tuple[int, int, int, int, str]]
 def _branch_init(key, cin, spec: BranchSpec, dtype):
     ps = []
     for kh, kw, cout, _, _ in spec:
-        key, sub = jax.random.split(key)
-        ps.append(_conv_init(sub, kh, kw, cin, cout, dtype))
+        ps.append(_conv_init(key, kh, kw, cin, cout, dtype))
         cin = cout
     return ps
 
@@ -186,13 +187,12 @@ def _block_init(key, variant, cin, dtype, pool_ch=0, c7=0):
     specs = _block_specs(variant, cin, pool_ch, c7)
     params = {}
     for name, spec in specs.items():
-        key, sub = jax.random.split(key)
         stem_cin = cin
         if variant == "E" and name in ("b3x3_a", "b3x3_b"):
             stem_cin = 384
         if variant == "E" and name in ("b3x3dbl_a", "b3x3dbl_b"):
             stem_cin = 384
-        params[name] = _branch_init(sub, stem_cin, spec, dtype)
+        params[name] = _branch_init(key, stem_cin, spec, dtype)
     return params
 
 
@@ -263,18 +263,39 @@ _STEM = [  # (kh, kw, cout, stride, padding, then_maxpool)
 ]
 
 
-def init(rng: jax.Array, dtype=jnp.bfloat16) -> Params:
+def _np_dtype(dtype):
+    """numpy dtype for host-side param storage (bf16 via ml_dtypes)."""
+    return np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+
+
+def init(rng, dtype=jnp.bfloat16) -> Params:
+    """Build frozen-inference parameters as HOST numpy arrays.
+
+    ``rng`` is an int seed or a jax PRNGKey (only its entropy is used).
+    Host-side construction matters on remote TPUs: params are captured by
+    the jitted scoring program and shipped in one transfer, instead of one
+    device dispatch per weight tensor."""
+    if hasattr(rng, "dtype"):
+        try:  # new-style typed keys (jax.random.key) are ndim-0
+            if jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+                rng = jax.random.key_data(rng)
+        except Exception:
+            pass
+    if hasattr(rng, "dtype") and getattr(rng, "ndim", 0) >= 1:
+        seed = int(np.asarray(rng).reshape(-1)[-1])
+    else:
+        seed = int(rng)
+    key = np.random.RandomState(seed & 0x7FFFFFFF)
+    dtype = _np_dtype(dtype)
     params: Params = {"stem": [], "blocks": []}
     cin = 3
     for kh, kw, cout, _, _, _ in _STEM:
-        rng, sub = jax.random.split(rng)
-        params["stem"].append(_conv_init(sub, kh, kw, cin, cout, dtype))
+        params["stem"].append(_conv_init(key, kh, kw, cin, cout, dtype))
         cin = cout
     # channel sizes after each block (standard v3): A:256,288,288; B:768;
     # C:768 x4; D:1280; E:2048 x2
     for variant, kw_ in _BLOCKS:
-        rng, sub = jax.random.split(rng)
-        params["blocks"].append(_block_init(sub, variant, cin, dtype, **kw_))
+        params["blocks"].append(_block_init(key, variant, cin, dtype, **kw_))
         if variant == "A":
             cin = 224 + kw_["pool_ch"]
         elif variant == "B":
@@ -285,11 +306,10 @@ def init(rng: jax.Array, dtype=jnp.bfloat16) -> Params:
             cin = cin + 320 + 192
         else:  # E
             cin = 2048
-    rng, sub = jax.random.split(rng)
-    params["fc_w"] = jax.random.normal(
-        sub, (cin, NUM_CLASSES), dtype
-    ) * np.float32(np.sqrt(1.0 / cin))
-    params["fc_b"] = jnp.zeros((NUM_CLASSES,), dtype)
+    params["fc_w"] = (
+        key.randn(cin, NUM_CLASSES) * np.sqrt(1.0 / cin)
+    ).astype(dtype)
+    params["fc_b"] = np.zeros((NUM_CLASSES,), dtype)
     return params
 
 

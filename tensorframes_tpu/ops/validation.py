@@ -39,7 +39,12 @@ class ValidationError(ValueError):
 
 
 def _column_for_input(
-    frame: TensorFrame, program: Program, input_name: str, verb: str
+    frame: TensorFrame,
+    program: Program,
+    input_name: str,
+    verb: str,
+    host_staged: bool = False,
+    allow_ragged: bool = False,
 ) -> ColumnInfo:
     col_name = program.column_for_input(input_name)
     schema = frame.schema
@@ -51,28 +56,63 @@ def _column_for_input(
             f"by name; pass feed_dict={{input: column}} to rename.)"
         )
     ci = schema[col_name]
+    if host_staged:
+        # a host_stage fn materialises this input on the host, so binary /
+        # ragged / un-analyzed columns are all legal here — the stage's
+        # output is what reaches the device
+        return ci
     if not ci.scalar_type.device_ok:
         raise ValidationError(
             f"{verb}: column {col_name!r} has host-only scalar type "
-            f"{ci.scalar_type} and cannot be fed to a device program. Binary "
-            f"columns can only be carried through as passthrough outputs."
+            f"{ci.scalar_type} and cannot be fed to a device program "
+            f"directly. Pass host_stage={{{input_name!r}: decode_fn}} to run "
+            f"a host-side preprocessing stage (e.g. JPEG decode -> uint8 "
+            f"pixels) before the device program — the reference's in-graph "
+            f"DecodeJpeg contract (read_image.py:164-167)."
         )
     if not ci.is_analyzed:
+        if allow_ragged:
+            # map_rows resolves ragged cells per row via size-bucketing
+            # (the reference's per-row lead-dim resolution,
+            # TFDataOps.scala:86-103); block verbs stay strict
+            return ci
         raise ValidationError(
             f"{verb}: column {col_name!r} has un-analyzed cell shape "
-            f"{ci.cell_shape}. Run tensorframes_tpu.analyze(frame) first, or "
-            f"construct the frame from uniform arrays."
+            f"{ci.cell_shape}. Run tensorframes_tpu.analyze(frame) first, "
+            f"construct the frame from uniform arrays, or use map_rows "
+            f"(which buckets ragged rows by shape)."
         )
     return ci
 
 
 def check_map_inputs(
-    program: Program, frame: TensorFrame, verb: str
+    program: Program,
+    frame: TensorFrame,
+    verb: str,
+    host_staged=(),
+    allow_ragged: bool = False,
 ) -> Dict[str, ColumnInfo]:
-    """Validate the inputs of map_blocks/map_rows; returns input->ColumnInfo."""
+    """Validate the inputs of map_blocks/map_rows; returns input->ColumnInfo.
+
+    ``host_staged``: input names whose data is produced by a host
+    preprocessing stage rather than fed from the column directly."""
+    staged = set(host_staged)
+    unknown = staged - set(program.input_names)
+    if unknown:
+        raise ValidationError(
+            f"{verb}: host_stage given for names {sorted(unknown)} that are "
+            f"not program inputs; inputs are {program.input_names}"
+        )
     out = {}
     for n in program.input_names:
-        out[n] = _column_for_input(frame, program, n, verb)
+        out[n] = _column_for_input(
+            frame,
+            program,
+            n,
+            verb,
+            host_staged=n in staged,
+            allow_ragged=allow_ragged,
+        )
     return out
 
 

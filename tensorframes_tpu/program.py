@@ -34,7 +34,7 @@ import numpy as np
 from . import dtypes
 from .dtypes import ScalarType
 from .schema import SchemaError
-from .shape import Shape
+from .shape import Shape, UNKNOWN
 
 
 class ProgramError(ValueError):
@@ -110,6 +110,9 @@ class Program:
         self._jitted = None
         self._vmapped = None
         self._derived: Dict[Any, Any] = {}
+        # output name -> Shape hint (ShapeDescription.scala:3-16); applied by
+        # analyze() as a refinement and checked by the verbs at run time
+        self._shape_hints: Dict[str, Shape] = {}
 
     # -- construction --------------------------------------------------------
 
@@ -192,13 +195,46 @@ class Program:
         """A copy with additional input->column renames merged in."""
         merged = dict(self._feed)
         merged.update(feed_dict)
-        return Program(
+        p = Program(
             self._fn,
             self._input_names + list(self._params),
             self._declared_fetches,
             merged,
             self._params,
         )
+        p._shape_hints = dict(self._shape_hints)
+        return p
+
+    def with_shape_hints(
+        self, hints: Mapping[str, Sequence[int]]
+    ) -> "Program":
+        """A copy carrying output-shape hints (the reference's
+        ``ShapeDescription`` override, ``TensorFlowOps.scala:126-133``):
+        each hint refines — never contradicts — the engine-inferred shape.
+        Applied by ``analyze`` and checked against real outputs by the map
+        verbs."""
+        p = Program(
+            self._fn,
+            self._input_names + list(self._params),
+            self._declared_fetches,
+            self._feed,
+            self._params,
+        )
+        p._shape_hints = dict(self._shape_hints)
+        for name, s in hints.items():
+            p._shape_hints[name] = Shape(s)
+        if self._declared_fetches is not None:
+            bad = sorted(set(p._shape_hints) - set(self._declared_fetches))
+            if bad:
+                raise ProgramError(
+                    f"shape hints for unknown outputs {bad}; program "
+                    f"outputs are {sorted(self._declared_fetches)}"
+                )
+        return p
+
+    @property
+    def shape_hints(self) -> Dict[str, Shape]:
+        return dict(self._shape_hints)
 
     # -- accessors -----------------------------------------------------------
 
@@ -382,10 +418,20 @@ class Program:
         """Shape-infer the program against input specs without executing it.
 
         ``input_specs``: input name -> (ScalarType, Shape) or ShapeDtypeStruct.
+        Specs may contain Unknown (-1) dims: the program is shape-evaluated at
+        two probe substitutions and output dims that depend on the unknown
+        inputs come back Unknown (the lattice merge ``analyze`` uses for data,
+        applied to programs).
+
         ``hints``: output name -> shape override (the ``ShapeDescription``
-        mechanism, ``ShapeDescription.scala:3-16``).
+        mechanism, ``ShapeDescription.scala:3-16``), merged over any hints
+        already attached via ``with_shape_hints``.  Hints *refine* inferred
+        shapes — an Unknown dim becomes the hinted value, a concrete dim must
+        agree (contradictions raise), mirroring the reference's hint-override
+        with the stronger never-contradict guarantee.
         """
-        structs = {}
+        shapes: Dict[str, Shape] = {}
+        stypes: Dict[str, Any] = {}
         for n in self._input_names:
             if n not in input_specs:
                 raise ProgramError(
@@ -394,37 +440,74 @@ class Program:
                 )
             spec = input_specs[n]
             if isinstance(spec, jax.ShapeDtypeStruct):
-                structs[n] = spec
+                shapes[n] = Shape(spec.shape)
+                stypes[n] = spec.dtype
             else:
                 st, shape = spec
-                if not Shape(shape).is_static:
-                    raise ProgramError(
-                        f"analyze: input {n!r} spec must be static, got "
-                        f"{Shape(shape)}"
-                    )
-                structs[n] = jax.ShapeDtypeStruct(
-                    tuple(Shape(shape)), st.np_dtype
+                shapes[n] = Shape(shape)
+                stypes[n] = st.np_dtype
+
+        def _eval(probe: int):
+            structs = {
+                n: jax.ShapeDtypeStruct(
+                    tuple(probe if d == UNKNOWN else d for d in shapes[n]),
+                    stypes[n],
                 )
-        out_structs = jax.eval_shape(lambda ins: self.call(ins), structs)
-        hints = dict(hints or {})
+                for n in self._input_names
+            }
+            return jax.eval_shape(lambda ins: self.call(ins), structs)
+
+        has_unknown = any(not s.is_static for s in shapes.values())
+        out_a = _eval(3)
+        out_shapes: Dict[str, Shape] = {}
+        if has_unknown:
+            # dims that track the probe are Unknown; dims stable across
+            # probes are genuinely static (the analyze lattice merge)
+            out_b = _eval(7)
+            for name in out_a:
+                sa, sb = Shape(out_a[name].shape), Shape(out_b[name].shape)
+                if sa.rank != sb.rank:
+                    raise ProgramError(
+                        f"analyze: output {name!r} changes rank with the "
+                        f"unknown input dims ({sa} vs {sb}); its shape "
+                        f"cannot be described"
+                    )
+                out_shapes[name] = sa.merge(sb)
+        else:
+            out_shapes = {n: Shape(s.shape) for n, s in out_a.items()}
+
+        merged_hints = dict(self._shape_hints)
+        for name, h in (hints or {}).items():
+            merged_hints[name] = Shape(h)
+        unknown_hints = sorted(set(merged_hints) - set(out_shapes))
+        if unknown_hints:
+            raise ProgramError(
+                f"shape hints given for non-existent outputs: "
+                f"{unknown_hints}; program outputs are {sorted(out_shapes)}"
+            )
+
         summaries: List[GraphNodeSummary] = []
         for n in self._input_names:
-            s = structs[n]
             summaries.append(
                 GraphNodeSummary(
-                    n, True, False, dtypes.from_numpy(s.dtype), Shape(s.shape)
+                    n, True, False, dtypes.from_numpy(stypes[n]), shapes[n]
                 )
             )
-        for name, s in out_structs.items():
-            shape = Shape(hints.pop(name)) if name in hints else Shape(s.shape)
+        for name, shape in out_shapes.items():
+            if name in merged_hints:
+                try:
+                    shape = shape.refine(
+                        merged_hints[name], context=f"output {name!r}"
+                    )
+                except Exception as e:
+                    raise ProgramError(str(e)) from e
             summaries.append(
                 GraphNodeSummary(
-                    name, False, True, dtypes.from_numpy(s.dtype), shape
+                    name,
+                    False,
+                    True,
+                    dtypes.from_numpy(out_a[name].dtype),
+                    shape,
                 )
-            )
-        if hints:
-            raise ProgramError(
-                f"shape hints given for non-existent outputs: {sorted(hints)}; "
-                f"program outputs are {sorted(out_structs)}"
             )
         return summaries

@@ -134,6 +134,31 @@ class Shape:
                 f"expected shape {other}{where}"
             )
 
+    def refine(self, hint: "Shape", context: str = "") -> "Shape":
+        """Overlay a user hint: unknown dims take the hint's value, concrete
+        dims must agree (hints refine, never contradict, the engine-inferred
+        shape — the ``ShapeDescription`` override contract,
+        ``TensorFlowOps.scala:126-133``)."""
+        if self.rank != hint.rank:
+            raise ShapeError(
+                f"shape hint {hint} has rank {hint.rank} but the inferred "
+                f"shape {self} has rank {self.rank}"
+                + (f" ({context})" if context else "")
+            )
+        out = []
+        for s, h in zip(self._dims, hint._dims):
+            if s == UNKNOWN:
+                out.append(h)
+            elif h == UNKNOWN or h == s:
+                out.append(s)
+            else:
+                raise ShapeError(
+                    f"shape hint {hint} contradicts the inferred shape "
+                    f"{self}: hints may only refine unknown dimensions"
+                    + (f" ({context})" if context else "")
+                )
+        return Shape(out)
+
     def merge(self, other: "Shape") -> "Shape":
         """Lattice join: pointwise agreement or Unknown; rank must match.
 

@@ -1,0 +1,145 @@
+"""Type-parameterized verb matrix.
+
+The reference runs every verb across Int/Long/Float/Double via abstract
+suites (``type_suites.scala:190-213``, ``CommonOperationsSuite.scala``); here
+the same matrix runs as pytest parametrization, extended with the TPU-native
+types (bool, uint8, bfloat16) the registry supports beyond the reference
+(``dtypes.py``).  Oracles are numpy computations in the same dtype.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.parallel import MeshExecutor
+
+NUMERIC = [
+    np.float32,
+    np.float64,
+    np.int32,
+    np.int64,
+    np.uint8,
+    jnp.bfloat16,
+]
+ALL = NUMERIC + [np.bool_]
+
+
+def _col(dtype, n=12):
+    if dtype is np.bool_:
+        return (np.arange(n) % 3 == 0)
+    if dtype is jnp.bfloat16:
+        return np.arange(n).astype(jnp.bfloat16)
+    if np.dtype(dtype).kind in "iu":
+        return np.arange(n).astype(dtype)
+    return (np.arange(n) * 0.5).astype(dtype)
+
+
+def _frame(dtype, n=12, blocks=3):
+    return tfs.analyze(
+        tfs.TensorFrame.from_arrays({"x": _col(dtype, n)}, num_blocks=blocks)
+    )
+
+
+@pytest.mark.parametrize("dtype", ALL)
+def test_map_blocks_identity(dtype):
+    f = _frame(dtype)
+    out = tfs.map_blocks(lambda x: {"y": x}, f)
+    got = np.asarray(out.column("y").data)
+    assert got.dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(got, _col(dtype))
+
+
+@pytest.mark.parametrize("dtype", NUMERIC)
+def test_map_blocks_add(dtype):
+    f = _frame(dtype)
+    out = tfs.map_blocks(lambda x: {"y": x + x}, f)
+    expect = _col(dtype) + _col(dtype)  # same-dtype numpy oracle (wraps u8)
+    np.testing.assert_array_equal(
+        np.asarray(out.column("y").data), expect
+    )
+
+
+@pytest.mark.parametrize("dtype", NUMERIC)
+def test_map_rows_scale(dtype):
+    f = _frame(dtype)
+    out = tfs.map_rows(lambda x: {"y": x * dtype(2)}, f)
+    expect = (_col(dtype) * dtype(2)).astype(np.dtype(dtype))
+    np.testing.assert_array_equal(np.asarray(out.column("y").data), expect)
+
+
+@pytest.mark.parametrize("dtype", NUMERIC)
+def test_reduce_rows_sum(dtype):
+    f = _frame(dtype)
+    out = tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, f)
+    expect = _col(dtype).sum(dtype=np.dtype(dtype))
+    np.testing.assert_allclose(
+        np.asarray(out["x"], dtype=np.float64),
+        np.float64(expect),
+        rtol=1e-2 if dtype is jnp.bfloat16 else 1e-6,
+    )
+
+
+def test_reduce_rows_bool_or():
+    f = _frame(np.bool_)
+    out = tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 | x_2}, f)
+    assert bool(out["x"]) is bool(_col(np.bool_).any())
+
+
+@pytest.mark.parametrize("dtype", NUMERIC)
+def test_reduce_blocks_sum(dtype):
+    f = _frame(dtype)
+    out = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, f)
+    expect = _col(dtype).sum(dtype=np.dtype(dtype))
+    np.testing.assert_allclose(
+        np.asarray(out["x"], dtype=np.float64),
+        np.float64(expect),
+        rtol=1e-2 if dtype is jnp.bfloat16 else 1e-6,
+    )
+
+
+def test_reduce_blocks_bool_any():
+    f = _frame(np.bool_)
+    out = tfs.reduce_blocks(lambda x_input: {"x": x_input.any(0)}, f)
+    assert bool(out["x"]) is True
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32, jnp.bfloat16])
+def test_aggregate_grouped_sum(dtype):
+    keys = np.array([0, 1, 0, 1, 2, 2, 0, 1], dtype=np.int64)
+    vals = np.arange(8).astype(dtype)
+    f = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"k": keys, "v": vals}, num_blocks=2)
+    )
+    out = tfs.aggregate(
+        lambda v_input: {"v": v_input.sum(0)}, tfs.group_by(f, "k")
+    )
+    arrs = out.to_arrays()
+    expect = {
+        k: vals[keys == k].sum(dtype=np.dtype(dtype)) for k in (0, 1, 2)
+    }
+    got = dict(
+        zip(np.asarray(arrs["k"]).tolist(), np.asarray(arrs["v"]).tolist())
+    )
+    for k, e in expect.items():
+        assert got[k] == pytest.approx(
+            float(e), rel=1e-2 if dtype is jnp.bfloat16 else 1e-6
+        )
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int64, np.uint8])
+def test_mesh_map_blocks_dtype(devices, dtype):
+    f = _frame(dtype, n=16, blocks=8)
+    out = tfs.map_blocks(lambda x: {"y": x + x}, f, engine=MeshExecutor())
+    expect = _col(dtype, 16) + _col(dtype, 16)
+    np.testing.assert_array_equal(np.asarray(out.column("y").data), expect)
+
+
+@pytest.mark.parametrize("dtype", ALL)
+def test_schema_round_trip(dtype):
+    f = _frame(dtype)
+    st = f.schema["x"].scalar_type
+    assert st.np_dtype == np.dtype(dtype)
+    out = tfs.map_blocks(lambda x: {"y": x}, f)
+    assert out.schema["y"].scalar_type.np_dtype == np.dtype(dtype)

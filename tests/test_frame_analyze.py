@@ -100,3 +100,61 @@ def test_schema_errors():
     tf = tfs.TensorFrame.from_arrays({"x": np.arange(3.0)})
     with pytest.raises(tfs.SchemaError):
         tf.column("nope")
+
+
+# ------------------------------------------------------- device cache ----
+
+
+def test_cache_pins_columns_on_device():
+    import tensorframes_tpu as tfs
+
+    f = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"x": np.arange(8.0)}, num_blocks=2)
+    )
+    cached = f.cache()
+    assert cached.column("x").is_device
+    assert not cached.column("x").is_ragged
+    # verbs read straight from HBM and results match the host path
+    out = tfs.map_blocks(lambda x: {"z": x + 1.0}, cached)
+    np.testing.assert_allclose(
+        np.asarray(out.column("z").data), np.arange(8.0) + 1.0
+    )
+    # uncache round-trips to host numpy
+    back = cached.uncache()
+    assert isinstance(back.column("x").data, np.ndarray)
+    np.testing.assert_allclose(back.column("x").data, np.arange(8.0))
+
+
+def test_cache_leaves_binary_and_ragged_on_host():
+    import tensorframes_tpu as tfs
+
+    f = tfs.TensorFrame.from_arrays(
+        {
+            "b": [b"ab", b"cdef"],
+            "r": [np.arange(2.0), np.arange(3.0)],
+            "x": np.arange(2.0),
+        }
+    )
+    cached = tfs.analyze(f).cache()
+    assert not cached.column("b").is_device
+    assert cached.column("r").is_ragged
+    assert cached.column("x").is_device
+
+
+def test_cache_refuses_demotable_64bit_without_x64(monkeypatch):
+    """cache() must never store a silently-truncated copy: when jax would
+    canonicalise a 64-bit column to 32-bit, the column stays on host."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import dtypes as dt
+
+    f = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"x": np.array([2**40, 1], np.int64)})
+    )
+    # simulate a no-x64 runtime (the TPU default) regardless of test config
+    monkeypatch.setattr(
+        dt, "coerce", lambda st, allow_x64=None: dt.by_name("int32")
+        if st.name == "int64" else st
+    )
+    cached = f.cache()
+    assert not cached.column("x").is_device
+    assert cached.column("x").data[0] == 2**40

@@ -346,3 +346,124 @@ def test_float_range_lowering():
     p = import_graphdef(b.build(), fetches=["z"])
     out = tfs.map_blocks(p, frame({"x": np.ones(3)}))
     np.testing.assert_allclose(out.column("z").data, np.full(3, 1.5))
+
+
+# ------------------------------------------- frozen conv-net scoring e2e --
+
+
+def test_frozen_convnet_scoring_end_to_end():
+    """A complete frozen conv-net GraphDef (conv / folded-BN / pooling /
+    dense head / softmax / argmax) scored through ``map_blocks`` over a raw
+    uint8 image column — the reference's flagship model-scoring contract
+    (``read_image.py:108-167``: restore -> freeze -> feed image rows), with
+    the in-graph Cast/normalise replacing the host-side decode."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tensorframes_tpu import OpBuilder
+    from tensorframes_tpu.graphdef.proto import AttrValue
+    from tensorframes_tpu import dtypes as dt
+
+    rng = np.random.RandomState(42)
+    n, side = 6, 16
+    images = rng.randint(0, 256, size=(n, side, side, 3), dtype=np.uint8)
+
+    w1 = rng.randn(3, 3, 3, 8).astype(np.float32) * 0.2
+    bn_scale = rng.rand(8).astype(np.float32) + 0.5
+    bn_offset = rng.randn(8).astype(np.float32) * 0.1
+    bn_mean = rng.randn(8).astype(np.float32) * 0.1
+    bn_var = rng.rand(8).astype(np.float32) + 0.5
+    w2 = rng.randn(3, 3, 8, 16).astype(np.float32) * 0.2
+    b2 = rng.randn(16).astype(np.float32) * 0.1
+    wfc = rng.randn(16, 10).astype(np.float32) * 0.3
+    bfc = rng.randn(10).astype(np.float32) * 0.1
+
+    g = GraphBuilder()
+    g.placeholder("image", "uint8", [-1, side, side, 3])
+    g.op(
+        "Cast", "to_float", ["image"],
+        DstT=AttrValue("type", dt.by_name("float32").tf_enum),
+    )
+    g.const("half_range", np.float32(127.5))
+    g.op("RealDiv", "scaled", ["to_float", "half_range"])
+    g.const("one", np.float32(1.0))
+    g.op("Sub", "normed", ["scaled", "one"])
+    g.const("w1", w1)
+    g.op(
+        "Conv2D", "conv1", ["normed", "w1"],
+        strides=[1, 2, 2, 1], padding=b"SAME",
+    )
+    g.const("bn_scale", bn_scale)
+    g.const("bn_offset", bn_offset)
+    g.const("bn_mean", bn_mean)
+    g.const("bn_var", bn_var)
+    g.op(
+        "FusedBatchNormV3", "bn1",
+        ["conv1", "bn_scale", "bn_offset", "bn_mean", "bn_var"],
+        epsilon=1e-3,
+    )
+    g.op("Relu", "act1", ["bn1"])
+    g.op(
+        "MaxPool", "pool1", ["act1"],
+        ksize=[1, 2, 2, 1], strides=[1, 2, 2, 1], padding=b"VALID",
+    )
+    g.const("w2", w2)
+    g.op(
+        "Conv2D", "conv2", ["pool1", "w2"],
+        strides=[1, 1, 1, 1], padding=b"SAME",
+    )
+    g.const("b2", b2)
+    g.op("BiasAdd", "bias2", ["conv2", "b2"])
+    g.op("Relu", "act2", ["bias2"])
+    g.const("gap_axes", np.asarray([1, 2], np.int32))
+    g.op("Mean", "gap", ["act2", "gap_axes"])
+    g.const("wfc", wfc)
+    g.op("MatMul", "fc", ["gap", "wfc"])
+    g.const("bfc", bfc)
+    g.op("BiasAdd", "logits", ["fc", "bfc"])
+    g.op("Softmax", "probs", ["logits"])
+    g.const("argmax_axis", np.int32(1))
+    g.op("ArgMax", "prediction", ["logits", "argmax_axis"])
+
+    # serialize -> wire bytes -> re-parse: the full GraphDef transport path
+    graph_bytes = g.to_bytes()
+
+    out = (
+        OpBuilder.map_blocks(frame({"image_data": images}, blocks=2))
+        .graph(graph_bytes)
+        .fetches(["probs", "prediction"])
+        .inputs({"image": "image_data"})
+        .build_df()
+    )
+
+    # oracle: same computation straight through jax
+    x = images.astype(np.float32) / 127.5 - 1.0
+    y = lax.conv_general_dilated(
+        x, w1, (2, 2), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    inv = bn_scale / np.sqrt(bn_var + 1e-3)
+    y = np.asarray(y) * inv + (bn_offset - bn_mean * inv)
+    y = np.maximum(y, 0)
+    y = np.asarray(
+        lax.reduce_window(y, -np.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    )
+    y = np.asarray(
+        lax.conv_general_dilated(
+            y, w2, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        )
+    )
+    y = np.maximum(y + b2, 0)
+    gap = y.mean(axis=(1, 2))
+    logits = gap @ wfc + bfc
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    pred = logits.argmax(axis=1)
+
+    np.testing.assert_allclose(
+        np.asarray(out.column("probs").data), probs, rtol=2e-4, atol=1e-6
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.column("prediction").data), pred
+    )
+    # passthrough column (non-trimmed map keeps inputs)
+    assert "image_data" in out.column_names

@@ -1,0 +1,195 @@
+"""Host preprocessing stage for binary columns.
+
+The reference feeds raw encoded image bytes into the graph and decodes
+in-graph (``read_image.py:164-167``: ``tfs.map_rows(out, df,
+feed_dict={'DecodeJpeg/contents': 'image_data'})``; Binary type at
+``datatypes.scala:571-622``).  XLA cannot host string/bytes tensors, so the
+TPU-native equivalent splits the op: decode on host (``host_stage``), score
+on device — same user contract, same row alignment.
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import OpBuilder, ValidationError
+from tensorframes_tpu.parallel import MeshExecutor
+
+
+SIDE = 4
+
+
+def _encode(img: np.ndarray) -> bytes:
+    """Stand-in codec for the tests (raw C-order bytes; a real deployment
+    would use JPEG — the host stage is arbitrary python)."""
+    return img.astype(np.uint8).tobytes()
+
+
+def _decode_cells(cells):
+    return np.stack(
+        [
+            np.frombuffer(c, dtype=np.uint8).reshape(SIDE, SIDE, 3)
+            for c in cells
+        ]
+    )
+
+
+def _image_frame(n=10, blocks=2, seed=0):
+    rng = np.random.RandomState(seed)
+    imgs = rng.randint(0, 256, size=(n, SIDE, SIDE, 3), dtype=np.uint8)
+    data = [_encode(im) for im in imgs]
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays(
+            {"image_data": data, "label": np.arange(n)}, num_blocks=blocks
+        )
+    )
+    return imgs, frame
+
+
+def _scorer(contents):
+    # [n, S, S, 3] uint8 -> mean-brightness "prediction" per row
+    x = contents.astype(np.float32) / 255.0
+    return {"prediction": x.mean(axis=(1, 2, 3))}
+
+
+def test_image_bytes_to_prediction_map_blocks():
+    """End-to-end: encoded bytes column -> host decode -> device scoring,
+    the read_image.py feed contract (binary column + feed_dict rename)."""
+    imgs, frame = _image_frame()
+    out = tfs.map_blocks(
+        _scorer,
+        frame,
+        feed_dict={"contents": "image_data"},
+        host_stage={"contents": _decode_cells},
+    )
+    expect = imgs.astype(np.float32).mean(axis=(1, 2, 3)) / 255.0
+    np.testing.assert_allclose(
+        np.asarray(out.column("prediction").data), expect, rtol=1e-6
+    )
+    # binary input column passes through untouched
+    assert "image_data" in out.column_names
+    assert out.column("image_data").cells()[0] == _encode(imgs[0])
+
+
+def test_image_bytes_map_rows_cell_level():
+    imgs, frame = _image_frame(n=7, blocks=3)
+
+    def cell_scorer(contents):  # one [S, S, 3] cell
+        return {"bright": contents.astype(np.float32).max()}
+
+    out = tfs.map_rows(
+        cell_scorer,
+        frame,
+        feed_dict={"contents": "image_data"},
+        host_stage={"contents": _decode_cells},
+    )
+    expect = imgs.reshape(7, -1).max(axis=1).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(out.column("bright").data), expect
+    )
+
+
+def test_binary_without_stage_error_mentions_host_stage():
+    _, frame = _image_frame()
+    with pytest.raises(ValidationError, match="host_stage"):
+        tfs.map_blocks(
+            _scorer, frame, feed_dict={"contents": "image_data"}
+        )
+
+
+def test_host_stage_via_op_builder():
+    imgs, frame = _image_frame()
+    out = (
+        OpBuilder.map_blocks(frame)
+        .graph(_scorer)
+        .inputs({"contents": "image_data"})
+        .host_stage("contents", _decode_cells)
+        .build_df()
+    )
+    expect = imgs.astype(np.float32).mean(axis=(1, 2, 3)) / 255.0
+    np.testing.assert_allclose(
+        np.asarray(out.column("prediction").data), expect, rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("mode", ["global", "per_block"])
+def test_host_stage_on_mesh(devices, mode):
+    imgs, frame = _image_frame(n=16, blocks=8)
+    ex = MeshExecutor(mode=mode)
+    out = tfs.map_blocks(
+        _scorer,
+        frame,
+        feed_dict={"contents": "image_data"},
+        host_stage={"contents": _decode_cells},
+        engine=ex,
+    )
+    expect = imgs.astype(np.float32).mean(axis=(1, 2, 3)) / 255.0
+    np.testing.assert_allclose(
+        np.asarray(out.column("prediction").data), expect, rtol=1e-6
+    )
+
+
+def test_host_stage_mesh_map_rows(devices):
+    imgs, frame = _image_frame(n=13, blocks=1)  # 13 rows: pad+mask path
+
+    def cell_scorer(contents):
+        return {"bright": contents.astype(np.float32).max()}
+
+    out = tfs.map_rows(
+        cell_scorer,
+        frame,
+        feed_dict={"contents": "image_data"},
+        host_stage={"contents": _decode_cells},
+        engine=MeshExecutor(),
+    )
+    expect = imgs.reshape(13, -1).max(axis=1).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(out.column("bright").data), expect)
+
+
+def test_host_stage_bad_lead_dim_raises():
+    _, frame = _image_frame()
+    with pytest.raises(ValidationError, match="lead dimension"):
+        tfs.map_blocks(
+            _scorer,
+            frame,
+            feed_dict={"contents": "image_data"},
+            host_stage={"contents": lambda cells: _decode_cells(cells)[:1]},
+        )
+
+
+def test_host_stage_unknown_input_raises():
+    _, frame = _image_frame()
+    with pytest.raises(ValidationError, match="not program inputs"):
+        tfs.map_blocks(
+            _scorer,
+            frame,
+            feed_dict={"contents": "image_data"},
+            host_stage={
+                "contents": _decode_cells,
+                "nope": _decode_cells,
+            },
+        )
+
+
+def test_host_stage_can_densify_ragged_column():
+    """A host stage may also bucket/pad a ragged numeric column — the
+    decode hook doubles as the ragged on-ramp (TFDataOps.scala:86-103)."""
+    cells = [np.arange(k, dtype=np.float64) for k in (3, 1, 2)]
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"v": cells}, num_blocks=1)
+    )
+
+    def pad3(cs):
+        out = np.zeros((len(cs), 3))
+        for i, c in enumerate(cs):
+            out[i, : len(c)] = c
+        return out
+
+    out = tfs.map_blocks(
+        lambda v: {"s": v.sum(axis=1)},
+        frame,
+        host_stage={"v": pad3},
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.column("s").data), [3.0, 0.0, 1.0]
+    )

@@ -1,0 +1,106 @@
+"""Ragged ``map_rows`` via shape-bucketing.
+
+The reference resolves variable-size per-row cells inside its converter
+(``TFDataOps.scala:86-103``, ``DataOps.inferPhysicalShape`` L105-144); the
+TPU engine buckets rows by concrete cell shape and vmaps each bucket
+(SURVEY.md §7 hard part 1; VERDICT r1 missing #4).
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import ValidationError
+from tensorframes_tpu.parallel import MeshExecutor
+
+
+def _ragged_frame(lengths, blocks=2, seed=0):
+    rng = np.random.RandomState(seed)
+    cells = [rng.rand(k) for k in lengths]
+    return (
+        cells,
+        tfs.analyze(
+            tfs.TensorFrame.from_arrays(
+                {"v": cells, "w": np.arange(float(len(cells)))},
+                num_blocks=blocks,
+            )
+        ),
+    )
+
+
+def test_ragged_map_rows_matches_per_row_oracle():
+    lengths = [3, 1, 4, 3, 2, 1, 4, 4]
+    cells, frame = _ragged_frame(lengths)
+    assert frame.column("v").is_ragged
+    out = tfs.map_rows(
+        lambda v, w: {"s": v.sum() * w, "m": v.max()}, frame
+    )
+    expect_s = np.array([c.sum() * i for i, c in enumerate(cells)])
+    expect_m = np.array([c.max() for c in cells])
+    np.testing.assert_allclose(np.asarray(out.column("s").data), expect_s)
+    np.testing.assert_allclose(np.asarray(out.column("m").data), expect_m)
+    # passthrough columns (including the ragged input) survive
+    assert set(out.column_names) == {"s", "m", "v", "w"}
+
+
+def test_ragged_map_rows_ragged_output():
+    lengths = [2, 3, 2]
+    cells, frame = _ragged_frame(lengths, blocks=1)
+    out = tfs.map_rows(lambda v: {"double": v * 2.0}, frame)
+    col = out.column("double")
+    assert col.is_ragged
+    for got, c in zip(col.cells(), cells):
+        np.testing.assert_allclose(got, c * 2.0)
+
+
+def test_ragged_map_rows_row_order_preserved_across_blocks():
+    lengths = [5, 1, 5, 1, 5, 1, 5, 1, 2]
+    cells, frame = _ragged_frame(lengths, blocks=3)
+    out = tfs.map_rows(lambda v: {"n": v.sum()}, frame)
+    np.testing.assert_allclose(
+        np.asarray(out.column("n").data), [c.sum() for c in cells]
+    )
+    assert out.offsets == frame.offsets
+
+
+def test_ragged_map_rows_on_mesh(devices):
+    lengths = [3, 1, 3, 3, 1, 3, 3, 3, 3, 1, 3, 3, 3]
+    cells, frame = _ragged_frame(lengths, blocks=1)
+    out = tfs.map_rows(
+        lambda v: {"s": v.sum()}, frame, engine=MeshExecutor()
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.column("s").data), [c.sum() for c in cells]
+    )
+
+
+def test_ragged_still_refused_by_block_verbs():
+    _, frame = _ragged_frame([2, 3, 2])
+    with pytest.raises(ValidationError, match="map_rows"):
+        tfs.map_blocks(lambda v: {"s": v.sum(axis=1)}, frame)
+    with pytest.raises(ValidationError):
+        tfs.reduce_blocks(lambda v_input: {"v": v_input.sum(0)}, frame)
+
+
+def test_ragged_mixed_with_uniform_input():
+    lengths = [2, 4, 2, 4]
+    cells, frame = _ragged_frame(lengths, blocks=2)
+    out = tfs.map_rows(lambda v, w: {"z": v.mean() + w}, frame)
+    np.testing.assert_allclose(
+        np.asarray(out.column("z").data),
+        [c.mean() + i for i, c in enumerate(cells)],
+    )
+
+
+def test_ragged_2d_cells():
+    rng = np.random.RandomState(1)
+    cells = [rng.rand(2, 3), rng.rand(4, 3), rng.rand(2, 3)]
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"m": cells}, num_blocks=1)
+    )
+    out = tfs.map_rows(lambda m: {"colsum": m.sum(axis=0)}, frame)
+    got = out.column("colsum")
+    assert not got.is_ragged  # all outputs are [3]
+    np.testing.assert_allclose(
+        np.asarray(got.data), np.stack([c.sum(axis=0) for c in cells])
+    )

@@ -317,9 +317,10 @@ def test_program_analyze_summaries():
     by_name = {s.name: s for s in summ}
     assert by_name["x"].is_input and by_name["z"].is_output
     assert by_name["z"].shape == (8,)
-    # hint override (ShapeDescription mechanism)
+    # hint override (ShapeDescription mechanism): hints refine — a -1 hint
+    # dim defers to the inferred concrete dim, never weakens it
     summ2 = p.analyze({"x": (dt.float32, (8,))}, hints={"z": (-1,)})
-    assert {s.name: s for s in summ2}["z"].shape == (tfs.UNKNOWN,)
+    assert {s.name: s for s in summ2}["z"].shape == (8,)
     with pytest.raises(tfs.ProgramError, match="non-existent"):
         p.analyze({"x": (dt.float32, (8,))}, hints={"nope": (1,)})
 
@@ -369,3 +370,109 @@ def test_program_params_in_reduce_and_aggregate():
     p.update_params(scale=np.float64(1.0))
     out2 = tfs.reduce_blocks(p, tf)
     assert float(out2["x"]) == 28.0
+
+
+# ------------------------------------------------ aggregate at scale ----
+
+
+def _dispatch_counter(monkeypatch):
+    from tensorframes_tpu.ops.engine import Executor
+
+    calls = {"n": 0}
+    orig = Executor._run_groups
+
+    def spy(self, vrun, batch):
+        calls["n"] += 1
+        return orig(self, vrun, batch)
+
+    monkeypatch.setattr(Executor, "_run_groups", spy)
+    return calls
+
+
+def test_aggregate_uniform_keys_single_dispatch(monkeypatch):
+    """Dense uniform key histogram -> ONE device dispatch (VERDICT r1 #7)."""
+    calls = _dispatch_counter(monkeypatch)
+    n_keys, per_key = 100, 50
+    keys = np.repeat(np.arange(n_keys), per_key)
+    rng = np.random.RandomState(0)
+    perm = rng.permutation(len(keys))
+    vals = rng.rand(len(keys))
+    f = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"k": keys[perm], "v": vals[perm]})
+    )
+    out = tfs.aggregate(
+        lambda v_input: {"v": v_input.sum(0)}, tfs.group_by(f, "k")
+    )
+    assert calls["n"] == 1
+    arrs = out.to_arrays()
+    expect = np.bincount(keys[perm], weights=vals[perm])
+    got = np.asarray(arrs["v"])[np.argsort(np.asarray(arrs["k"]))]
+    np.testing.assert_allclose(got, expect)
+
+
+def test_aggregate_skewed_keys_log_dispatches(monkeypatch):
+    """Heavy size skew (every group a different size) runs the pairwise
+    combine tree: O(log max_count) dispatches, not O(#distinct sizes)."""
+    calls = _dispatch_counter(monkeypatch)
+    sizes = np.arange(1, 41)  # 40 distinct sizes, max 40
+    keys = np.concatenate([np.full(s, i) for i, s in enumerate(sizes)])
+    rng = np.random.RandomState(1)
+    perm = rng.permutation(len(keys))
+    vals = rng.rand(len(keys))
+    f = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"k": keys[perm], "v": vals[perm]})
+    )
+    out = tfs.aggregate(
+        lambda v_input: {"v": v_input.sum(0)}, tfs.group_by(f, "k")
+    )
+    # ceil(log2(40)) = 6 levels
+    assert calls["n"] <= 7, calls["n"]
+    arrs = out.to_arrays()
+    expect = np.bincount(keys[perm], weights=vals[perm])
+    got = np.asarray(arrs["v"])[np.argsort(np.asarray(arrs["k"]))]
+    np.testing.assert_allclose(got, expect)
+
+
+def test_aggregate_skewed_vector_cells():
+    sizes = [1, 3, 7, 2, 9, 4, 6, 5, 8, 10, 11, 1]
+    keys = np.concatenate([np.full(s, i) for i, s in enumerate(sizes)])
+    rng = np.random.RandomState(2)
+    vals = rng.rand(len(keys), 3)
+    f = tfs.analyze(tfs.TensorFrame.from_arrays({"k": keys, "v": vals}))
+    out = tfs.aggregate(
+        lambda v_input: {"v": v_input.sum(0)}, tfs.group_by(f, "k")
+    )
+    arrs = out.to_arrays()
+    order = np.argsort(np.asarray(arrs["k"]))
+    for i, s in enumerate(sizes):
+        np.testing.assert_allclose(
+            np.asarray(arrs["v"])[order][i],
+            vals[keys == i].sum(0),
+            rtol=1e-9,
+        )
+
+
+def test_aggregate_scale_smoke():
+    """1e6 rows x 1e4 uniform keys completes fast in one dispatch
+    (the Criteo-style config #5 shape; VERDICT r1 item 7)."""
+    import time
+
+    n_keys = 10_000
+    per_key = 100
+    keys = np.repeat(np.arange(n_keys), per_key)
+    vals = np.ones(len(keys))
+    f = tfs.analyze(tfs.TensorFrame.from_arrays({"k": keys, "v": vals}))
+    grouped = tfs.group_by(f, "k")
+    program = tfs.Program.wrap(
+        lambda v_input: {"v": v_input.sum(0)}, fetches=["v"]
+    )
+    from tensorframes_tpu.ops.engine import _DEFAULT
+
+    _DEFAULT.aggregate(program, grouped)  # warm trace
+    t0 = time.perf_counter()
+    out = _DEFAULT.aggregate(program, grouped)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 5.0, f"aggregate took {elapsed:.2f}s"
+    np.testing.assert_allclose(
+        np.asarray(out.to_arrays()["v"]), np.full(n_keys, per_key * 1.0)
+    )

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Test runner (the reference's python/run-tests.sh analog): builds the
+# optional native extension, then runs the suite on the virtual 8-device
+# CPU mesh (tests/conftest.py pins JAX_PLATFORMS=cpu + 8 host devices).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== building native extension (optional) =="
+python -m tensorframes_tpu.native.build || echo "native build failed; numpy fallback will be used"
+
+echo "== pytest =="
+exec python -m pytest tests/ -q "$@"

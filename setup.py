@@ -1,0 +1,21 @@
+"""Build shim: compiles the optional native data-plane extension.
+
+The package is pure python plus one CPython extension (the row-cell packer,
+``tensorframes_tpu/native/packer.cpp`` — the hot loop the reference runs as
+JVM ``TensorConverter`` appends over JNI, ``datatypes.scala:93-127``).  The
+extension is *optional*: every caller falls back to the numpy pack path when
+it is absent, so a failed native build still yields a working install.
+"""
+
+from setuptools import Extension, setup
+
+setup(
+    ext_modules=[
+        Extension(
+            "tensorframes_tpu.native._native",
+            sources=["tensorframes_tpu/native/packer.cpp"],
+            extra_compile_args=["-O3", "-std=c++17"],
+            optional=True,  # numpy fallback keeps the install usable
+        )
+    ]
+)

@@ -18,9 +18,10 @@ The user-facing module mirrors the reference's python API
     s = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, tf)
 """
 
-from . import dsl
+from . import dsl, observability
 from .analyze import analyze, explain, print_schema
 from .builder import OpBuilder
+from .observability import initialize_logging
 from .dsl import block, row
 from .dtypes import ScalarType, by_name as scalar_type, supported_types
 from .frame import TensorFrame
@@ -52,6 +53,8 @@ __all__ = [
     "block",
     "row",
     "OpBuilder",
+    "observability",
+    "initialize_logging",
     "analyze",
     "explain",
     "print_schema",

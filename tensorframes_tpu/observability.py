@@ -1,0 +1,146 @@
+"""Per-verb timing and profiling hooks.
+
+The reference's observability is a Logging trait + log4j config + pervasive
+``logDebug``/``logTrace`` in its data plane (``Logging.scala:5-9``,
+``TFDataOps.scala:34-35``, ``PythonInterface.initialize_logging``,
+``PythonInterface.scala:29-44``).  The TPU-native equivalents:
+
+* ``initialize_logging(level)`` — one-call logger setup (the
+  ``initialize_logging`` analog; PySpark misconfigured log4j, ad-hoc scripts
+  misconfigure ``logging`` the same way);
+* ``enable(profile_dir=None)`` — opt-in per-verb phase spans.  Every verb
+  then logs ``validate / dispatch / sync`` wall times (the phases that matter
+  on an async data plane: dispatch = host work to enqueue all blocks, sync =
+  time to materialise results).  With ``profile_dir`` set, each verb call is
+  additionally wrapped in a ``jax.profiler`` trace whose dump can be opened
+  in TensorBoard/XProf — the real tool for on-device timeline analysis;
+* ``last_spans()`` — the most recent spans as dicts (programmatic access;
+  what ``bench.py`` surfaces as its phase breakdown).
+
+Deliberately cheap: a disabled span is one ``if``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("tensorframes_tpu")
+_verb_log = logging.getLogger("tensorframes_tpu.verbs")
+
+_MAX_SPANS = 256
+
+_state: Dict[str, Any] = {
+    "enabled": False,
+    "profile_dir": None,
+    "spans": [],
+}
+
+
+def initialize_logging(level=logging.INFO, stream=None) -> None:
+    """Configure the framework loggers with a sane handler/format.
+
+    Reference analog: ``PythonInterface.initialize_logging``
+    (``PythonInterface.scala:29-44``)."""
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s"
+        )
+    )
+    logger.handlers[:] = [handler]
+    logger.setLevel(level)
+    logger.propagate = False
+
+
+def enable(profile_dir: Optional[str] = None) -> None:
+    """Turn on per-verb phase spans (and jax.profiler traces when
+    ``profile_dir`` is given)."""
+    _state["enabled"] = True
+    _state["profile_dir"] = profile_dir
+
+
+def disable() -> None:
+    _state["enabled"] = False
+    _state["profile_dir"] = None
+
+
+def is_enabled() -> bool:
+    return bool(_state["enabled"])
+
+
+def last_spans(n: int = 10) -> List[Dict[str, Any]]:
+    """The most recent verb spans, newest last."""
+    return [dict(s) for s in _state["spans"][-n:]]
+
+
+class _Span:
+    """One verb invocation's phase timings."""
+
+    __slots__ = ("verb", "meta", "phases", "_t0", "_last")
+
+    def __init__(self, verb: str, meta: Dict[str, Any]):
+        self.verb = verb
+        self.meta = meta
+        self.phases: Dict[str, float] = {}
+        self._t0 = time.perf_counter()
+        self._last = self._t0
+
+    def mark(self, phase: str) -> None:
+        """Close the current phase under ``phase``."""
+        now = time.perf_counter()
+        self.phases[phase] = self.phases.get(phase, 0.0) + (now - self._last)
+        self._last = now
+
+    def _finish(self) -> Dict[str, Any]:
+        total = time.perf_counter() - self._t0
+        rec = {
+            "verb": self.verb,
+            **self.meta,
+            "phases_s": {k: round(v, 6) for k, v in self.phases.items()},
+            "total_s": round(total, 6),
+        }
+        spans = _state["spans"]
+        spans.append(rec)
+        del spans[:-_MAX_SPANS]
+        _verb_log.info(
+            "%s rows=%s blocks=%s %s total=%.4fs",
+            self.verb,
+            self.meta.get("rows"),
+            self.meta.get("blocks"),
+            " ".join(f"{k}={v:.4f}s" for k, v in self.phases.items()),
+            total,
+        )
+        return rec
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def mark(self, phase: str) -> None:  # noqa: D102
+        pass
+
+
+_NULL = _NullSpan()
+
+
+@contextlib.contextmanager
+def verb_span(verb: str, rows: int, blocks: int):
+    """Context manager wrapping one verb invocation.
+
+    Yields a span with ``.mark(phase)``; a no-op singleton when disabled."""
+    if not _state["enabled"]:
+        yield _NULL
+        return
+    span = _Span(verb, {"rows": rows, "blocks": blocks})
+    profile_dir = _state["profile_dir"]
+    if profile_dir:
+        import jax
+
+        with jax.profiler.trace(profile_dir):
+            yield span
+    else:
+        yield span
+    span._finish()

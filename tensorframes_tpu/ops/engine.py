@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import dtypes
+from .. import dtypes, observability
 from ..frame import Column, TensorFrame
 from ..program import Program
 from ..schema import ColumnInfo, Schema
@@ -191,34 +191,42 @@ class Executor:
         ``host_stage``: input name -> host fn(cells) -> [rows, *cell] array,
         run per block before the device program (binary decode, bucketing);
         block N+1's host stage overlaps block N's device compute."""
-        infos = validation.check_map_inputs(
-            program, frame, "map_blocks", host_staged=host_stage or ()
-        )
-        out_blocks: List[Dict[str, Any]] = []
-        for bi in range(frame.num_blocks):
-            block = frame.block(bi)
-            n_rows = len(next(iter(block.values())))
-            inputs = self._device_inputs(program, block, infos, host_stage)
-            outs = self._run_block_program(program, inputs)
-            if not trim:
-                for name, v in outs.items():
-                    if v.ndim == 0 or v.shape[0] != n_rows:
+        with observability.verb_span(
+            "map_blocks", frame.num_rows, frame.num_blocks
+        ) as span:
+            infos = validation.check_map_inputs(
+                program, frame, "map_blocks", host_staged=host_stage or ()
+            )
+            span.mark("validate")
+            out_blocks: List[Dict[str, Any]] = []
+            for bi in range(frame.num_blocks):
+                block = frame.block(bi)
+                n_rows = len(next(iter(block.values())))
+                inputs = self._device_inputs(program, block, infos, host_stage)
+                outs = self._run_block_program(program, inputs)
+                if not trim:
+                    for name, v in outs.items():
+                        if v.ndim == 0 or v.shape[0] != n_rows:
+                            raise ValidationError(
+                                f"map_blocks: output {name!r} has shape "
+                                f"{v.shape} but the input block has {n_rows} "
+                                f"rows; a non-trimmed map must preserve the "
+                                f"row count (use map_blocks_trimmed to "
+                                f"change it)."
+                            )
+                else:
+                    counts = {
+                        v.shape[0] if v.ndim else None for v in outs.values()
+                    }
+                    if len(counts) != 1 or None in counts:
                         raise ValidationError(
-                            f"map_blocks: output {name!r} has shape "
-                            f"{v.shape} but the input block has {n_rows} "
-                            f"rows; a non-trimmed map must preserve the row "
-                            f"count (use map_blocks_trimmed to change it)."
+                            f"map_blocks_trimmed: outputs disagree on row "
+                            f"count: { {k: v.shape for k, v in outs.items()} }"
                         )
-            else:
-                counts = {v.shape[0] if v.ndim else None for v in outs.values()}
-                if len(counts) != 1 or None in counts:
-                    raise ValidationError(
-                        f"map_blocks_trimmed: outputs disagree on row count: "
-                        f"{ {k: v.shape for k, v in outs.items()} }"
-                    )
-            _check_shape_hints(program, outs, "map_blocks", cell_level=False)
-            out_blocks.append(outs)
-        return self._build_map_output(frame, out_blocks, trim)
+                _check_shape_hints(program, outs, "map_blocks", cell_level=False)
+                out_blocks.append(outs)
+            span.mark("dispatch")
+            return self._build_map_output(frame, out_blocks, trim)
 
     def map_rows(
         self,
@@ -229,32 +237,39 @@ class Executor:
         """``mapRows`` (``DebugRowOps.scala:396-477``): the program is written
         at *cell* level and vmapped over the block's rows.  Ragged input
         columns are resolved per row by shape-bucketing (`_map_rows_ragged`)."""
-        infos = validation.check_map_inputs(
-            program,
-            frame,
-            "map_rows",
-            host_staged=host_stage or (),
-            allow_ragged=True,
-        )
-        ragged = [
-            n
-            for n in program.input_names
-            if not (host_stage and n in host_stage)
-            and frame.column(program.column_for_input(n)).is_ragged
-        ]
-        if ragged:
-            return self._map_rows_ragged(
-                program, frame, infos, host_stage, ragged
+        with observability.verb_span(
+            "map_rows", frame.num_rows, frame.num_blocks
+        ) as span:
+            infos = validation.check_map_inputs(
+                program,
+                frame,
+                "map_rows",
+                host_staged=host_stage or (),
+                allow_ragged=True,
             )
-        vmapped = program.vmapped()
-        out_blocks: List[Dict[str, Any]] = []
-        for bi in range(frame.num_blocks):
-            block = frame.block(bi)
-            inputs = self._device_inputs(program, block, infos, host_stage)
-            outs = vmapped(inputs)
-            _check_shape_hints(program, outs, "map_rows", cell_level=True)
-            out_blocks.append(outs)
-        return self._build_map_output(frame, out_blocks, trim=False)
+            span.mark("validate")
+            ragged = [
+                n
+                for n in program.input_names
+                if not (host_stage and n in host_stage)
+                and frame.column(program.column_for_input(n)).is_ragged
+            ]
+            if ragged:
+                out = self._map_rows_ragged(
+                    program, frame, infos, host_stage, ragged
+                )
+                span.mark("dispatch")
+                return out
+            vmapped = program.vmapped()
+            out_blocks: List[Dict[str, Any]] = []
+            for bi in range(frame.num_blocks):
+                block = frame.block(bi)
+                inputs = self._device_inputs(program, block, infos, host_stage)
+                outs = vmapped(inputs)
+                _check_shape_hints(program, outs, "map_rows", cell_level=True)
+                out_blocks.append(outs)
+            span.mark("dispatch")
+            return self._build_map_output(frame, out_blocks, trim=False)
 
     def _run_rows_bucket(
         self, program: Program, arrays: Dict[str, jnp.ndarray]
@@ -466,27 +481,34 @@ class Executor:
     ) -> Dict[str, np.ndarray]:
         """``reduceRows`` (``DebugRowOps.scala:479-501``): pairwise-fold all
         rows of the named columns down to one row."""
-        bases, reduced, run = self._reduce_rows_setup(program, frame, mode)
-        partials: List[Dict[str, jnp.ndarray]] = []
-        for bi in range(frame.num_blocks):
-            if frame.block_sizes[bi] == 0:
-                continue  # empty-partition guard (DebugRowOps.scala:489-499)
-            block = frame.block(bi)
-            arrays = {
-                b: self._device_value(
-                    block[b], dtypes.coerce(reduced[b].scalar_type)
-                )
-                for b in bases
-            }
-            partials.append(run(arrays))
-        if len(partials) == 1:
-            final = partials[0]
-        else:
-            stacked = {
-                b: jnp.stack([p[b] for p in partials]) for b in bases
-            }
-            final = run(stacked)
-        return {b: _np(final[b]) for b in bases}
+        with observability.verb_span(
+            "reduce_rows", frame.num_rows, frame.num_blocks
+        ) as span:
+            bases, reduced, run = self._reduce_rows_setup(program, frame, mode)
+            span.mark("validate")
+            partials: List[Dict[str, jnp.ndarray]] = []
+            for bi in range(frame.num_blocks):
+                if frame.block_sizes[bi] == 0:
+                    continue  # empty-partition guard (DebugRowOps:489-499)
+                block = frame.block(bi)
+                arrays = {
+                    b: self._device_value(
+                        block[b], dtypes.coerce(reduced[b].scalar_type)
+                    )
+                    for b in bases
+                }
+                partials.append(run(arrays))
+            if len(partials) == 1:
+                final = partials[0]
+            else:
+                stacked = {
+                    b: jnp.stack([p[b] for p in partials]) for b in bases
+                }
+                final = run(stacked)
+            span.mark("dispatch")
+            out = {b: _np(final[b]) for b in bases}
+            span.mark("sync")
+            return out
 
     def _reduce_blocks_setup(
         self, program: Program, frame: TensorFrame, verb: str = "reduce_blocks"
@@ -529,25 +551,34 @@ class Executor:
         """``reduceBlocks`` (``DebugRowOps.scala:503-526``): phase 1 reduces
         each block to one row with the user's block program; phase 2 re-applies
         the same program once to the stacked per-block partials."""
-        bases, reduced, run = self._reduce_blocks_setup(program, frame)
-        partials: List[Dict[str, jnp.ndarray]] = []
-        for bi in range(frame.num_blocks):
-            if frame.block_sizes[bi] == 0:
-                continue  # empty-partition guard (DebugRowOps.scala:512-522)
-            block = frame.block(bi)
-            arrays = {
-                b: self._device_value(
-                    block[b], dtypes.coerce(reduced[b].scalar_type)
-                )
-                for b in bases
-            }
-            partials.append(run(arrays))
-        if len(partials) == 1:
-            final = partials[0]
-        else:
-            stacked = {b: jnp.stack([p[b] for p in partials]) for b in bases}
-            final = run(stacked)
-        return {b: _np(final[b]) for b in bases}
+        with observability.verb_span(
+            "reduce_blocks", frame.num_rows, frame.num_blocks
+        ) as span:
+            bases, reduced, run = self._reduce_blocks_setup(program, frame)
+            span.mark("validate")
+            partials: List[Dict[str, jnp.ndarray]] = []
+            for bi in range(frame.num_blocks):
+                if frame.block_sizes[bi] == 0:
+                    continue  # empty-partition guard (DebugRowOps:512-522)
+                block = frame.block(bi)
+                arrays = {
+                    b: self._device_value(
+                        block[b], dtypes.coerce(reduced[b].scalar_type)
+                    )
+                    for b in bases
+                }
+                partials.append(run(arrays))
+            if len(partials) == 1:
+                final = partials[0]
+            else:
+                stacked = {
+                    b: jnp.stack([p[b] for p in partials]) for b in bases
+                }
+                final = run(stacked)
+            span.mark("dispatch")
+            out = {b: _np(final[b]) for b in bases}
+            span.mark("sync")
+            return out
 
     # ---------------------------------------------------------- aggregate --
 
@@ -569,6 +600,14 @@ class Executor:
         Groups are bucketed by cardinality and each bucket runs as ONE
         ``vmap``-ed device call over all its groups — the TPU-shaped
         replacement for Spark's shuffle + row-buffered UDAF."""
+        with observability.verb_span(
+            "aggregate", grouped.frame.num_rows, grouped.frame.num_blocks
+        ) as span:
+            return self._aggregate_impl(program, grouped, span)
+
+    def _aggregate_impl(
+        self, program: Program, grouped: GroupedFrame, span
+    ) -> TensorFrame:
         frame = grouped.frame
         reduced = validation.check_reduce_blocks(program, frame, verb="aggregate")
         bases = sorted(reduced)
@@ -611,6 +650,7 @@ class Executor:
         validation.check_reduce_blocks_outputs(
             reduced, summaries, verb="aggregate"
         )
+        span.mark("validate_and_group_index")
 
         # --- data columns, reordered so groups are contiguous ---
         data = {}
@@ -657,6 +697,7 @@ class Executor:
                     np.arange(num_groups, dtype=np.int64), counts
                 ), num_groups
             )
+        span.mark("execute")
 
         # --- assemble one-block result: keys ++ outputs, one row per group ---
         cols: List[Column] = []

@@ -12,5 +12,20 @@ with XLA collectives over a ``jax.sharding.Mesh``:
 
 from .dist import MeshExecutor
 from .mesh import data_mesh, device_count, training_mesh
+from .multihost import (
+    frame_from_process_local,
+    initialize,
+    process_count,
+    process_index,
+)
 
-__all__ = ["MeshExecutor", "data_mesh", "device_count", "training_mesh"]
+__all__ = [
+    "MeshExecutor",
+    "data_mesh",
+    "device_count",
+    "training_mesh",
+    "initialize",
+    "frame_from_process_local",
+    "process_count",
+    "process_index",
+]

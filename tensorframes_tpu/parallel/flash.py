@@ -1,0 +1,215 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The transformer's attention is the FLOPs *and* HBM hot spot: the reference
+XLA path (``parallel/ring.py::full_attention``) materialises the [B, H, L, L]
+score matrix in HBM — O(L^2) bytes of traffic.  This kernel computes the
+same softmax(QK^T)V with the online-softmax recurrence, streaming K/V blocks
+through VMEM and keeping the running (max, denom, accumulator) state on-chip:
+O(L) HBM traffic, MXU matmuls, f32 accumulation.
+
+Scope: the single-sequence-shard case (``sp == 1`` — positions are the
+row-major ``arange``).  Sequence-sharded attention is ``ring_attention``
+(``parallel/ring.py``), whose per-chunk math could host this kernel as its
+local step.  The backward pass recomputes through the XLA reference path
+(``custom_vjp``): scoring/inference — the framework's flagship map verb
+workload — never runs it, and training at sp>1 uses ring attention anyway.
+
+Off-TPU (the CPU test mesh) the kernel runs in Pallas interpret mode, so the
+same code path is exercised everywhere.
+
+Measured (single v5e via remote tunnel, B=2 H=8 Dh=128 bf16, vs the XLA
+reference path): crossover at ~8k tokens (1.26x faster at L=8192), and the
+kernel's O(L) memory keeps long contexts (L=16384: 0.54 s/iter) inside HBM
+headroom that the O(L^2) score materialisation burns.  At short L the fused
+XLA path wins — ``attn_impl`` stays per-config, "full" default.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+
+
+def _flash_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    seq_q: int,
+    seq_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal block skip: a k block strictly above the diagonal contributes
+    # nothing to this q block — skip its matmuls entirely (~2x fewer FLOPs
+    # and VMEM loads at long L)
+    needed = True
+    if causal:
+        needed = (qi + 1) * block_q - 1 >= ki * block_k
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]  # [block_q, dh]
+        k = k_ref[0]  # [block_k, dh]
+        v = v_ref[0]
+
+        s = (
+            jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+            * np.float32(scale)
+        )  # [block_q, block_k] f32
+
+        q_idx = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_idx = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_idx < seq_k  # padded keys contribute nothing
+        if causal:
+            mask &= q_idx >= k_idx
+        s_masked = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:]  # [block_q, 1]
+        l_prev = l_scr[:]
+        m_new = jnp.maximum(m_prev, s_masked.max(axis=-1, keepdims=True))
+        # -inf-safe online softmax: rows with no unmasked key yet keep
+        # m=-inf and contribute zeros (exp(-inf - 0) == 0), never NaNs
+        m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s_masked - m_safe)  # masked: exp(-inf - finite) == 0
+        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+        l_new = alpha * l_prev + p.sum(axis=-1, keepdims=True)
+        acc = acc_scr[:] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+        acc_scr[:] = acc
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finish():
+        l_fin = l_scr[:]
+        denom = jnp.where(l_fin == 0.0, 1.0, l_fin)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _pad_to(x, length, axis):
+    pad = length - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    B, Lq, H, Dh = q.shape
+    Lk = k.shape[1]
+    scale = 1.0 / np.sqrt(Dh)
+
+    bq = min(block_q, max(8, Lq))
+    bk = min(block_k, max(8, Lk))
+    Lq_p = -(-Lq // bq) * bq
+    Lk_p = -(-Lk // bk) * bk
+
+    # [B, L, H, D] -> [B*H, L_padded, D]
+    def to_bh(x, L_p):
+        x = jnp.swapaxes(x, 1, 2).reshape(B * H, x.shape[1], Dh)
+        return _pad_to(x, L_p, axis=1)
+
+    qb, kb, vb = to_bh(q, Lq_p), to_bh(k, Lk_p), to_bh(v, Lk_p)
+    grid = (B * H, Lq_p // bq, Lk_p // bk)
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            scale=scale,
+            causal=causal,
+            block_q=bq,
+            block_k=bk,
+            seq_q=Lq,
+            seq_k=Lk,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, Dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, Dh), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lq_p, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),   # running row max
+            pltpu.VMEM((bq, 1), jnp.float32),   # running denominator
+            pltpu.VMEM((bq, Dh), jnp.float32),  # f32 output accumulator
+        ],
+        interpret=interpret,
+    )(qb, kb, vb)
+
+    out = out[:, :Lq].reshape(B, H, Lq, Dh)
+    return jnp.swapaxes(out, 1, 2)  # [B, Lq, H, Dh]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """softmax(QK^T / sqrt(d)) V with online softmax in a Pallas kernel.
+
+    q: [B, Lq, H, Dh]; k/v: [B, Lk, H, Dh] (GQA heads already repeated,
+    matching ``full_attention``'s contract).  Causal masking uses row-major
+    positions (``arange``) — the sp == 1 case; use ``ring_attention`` for
+    sequence-sharded inputs.
+    """
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, residuals, g):
+    # backward recomputes through the XLA reference kernel: identical math
+    # (f32 softmax, f32-accumulated matmuls), so gradients match the
+    # forward's numerics; see module docstring for scope rationale
+    from .ring import full_attention
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: full_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,157 @@
+"""Failure detection + elastic recovery: restartable step drivers.
+
+The reference has NO failure handling of its own — it delegates wholesale to
+Spark task retry/lineage (SURVEY.md §5 "Failure detection"), which replays a
+failed partition's work from the RDD lineage.  A TPU pod has no lineage to
+replay: the unit of recovery is the *checkpointed step*.  This module is
+that story, made concrete:
+
+* ``run_restartable`` — drives an iterative step function with periodic
+  checkpoints; on a device/runtime failure it restores the last durable
+  state and resumes, up to ``max_restarts``.  Transient failure classes
+  (preemption, halted device, collective timeout) are distinguished from
+  programming errors (shape/type errors re-raise immediately — retrying a
+  deterministic bug is Spark's pathology, not a feature worth copying).
+* ``FailureDetector`` — classifies exceptions and keeps a restart budget
+  with exponential backoff.
+
+Elasticity note: resuming onto a *different* device topology is supported by
+construction — ``Checkpointer.restore(target=...)`` re-shards saved arrays
+to whatever mesh the resumed process builds (tested in
+``tests/test_transformer.py::test_checkpoint_restore_onto_different_mesh``);
+the driver only needs to rebuild its mesh from the surviving
+``jax.devices()`` before calling ``run_restartable`` again.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Optional, Tuple
+
+_log = logging.getLogger("tensorframes_tpu.resilience")
+
+# exception text fragments that indicate the *runtime* (not the program)
+# failed: device preemption / halt, RPC loss, collective timeouts
+_TRANSIENT_MARKERS = (
+    "preempt",
+    "halted",
+    "unavailable",
+    "deadline exceeded",
+    "socket closed",
+    "connection reset",
+    "collective",
+    "slice has been terminated",
+    "data transfer",
+    "internal: ",
+)
+
+# deterministic program errors: retrying cannot help
+_FATAL_TYPES = (TypeError, ValueError, KeyError, AttributeError)
+
+
+class RestartBudgetExceeded(RuntimeError):
+    """The step kept failing after ``max_restarts`` recoveries."""
+
+
+class FailureDetector:
+    """Classifies failures and meters restarts with exponential backoff."""
+
+    def __init__(
+        self,
+        max_restarts: int = 3,
+        backoff_s: float = 1.0,
+        backoff_factor: float = 2.0,
+    ):
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.backoff_factor = backoff_factor
+        self.restarts = 0
+
+    def is_transient(self, exc: BaseException) -> bool:
+        if isinstance(exc, _FATAL_TYPES) and not isinstance(
+            exc, FloatingPointError
+        ):
+            return False
+        text = f"{type(exc).__name__}: {exc}".lower()
+        return any(m in text for m in _TRANSIENT_MARKERS)
+
+    def on_failure(self, exc: BaseException) -> float:
+        """Record a failure; returns the backoff to sleep, or raises."""
+        if not self.is_transient(exc):
+            raise exc
+        self.restarts += 1
+        if self.restarts > self.max_restarts:
+            raise RestartBudgetExceeded(
+                f"step failed {self.restarts} times; last error: {exc!r}"
+            ) from exc
+        delay = self.backoff_s * self.backoff_factor ** (self.restarts - 1)
+        _log.warning(
+            "transient failure (%s); restart %d/%d after %.1fs",
+            exc,
+            self.restarts,
+            self.max_restarts,
+            delay,
+        )
+        return delay
+
+
+def run_restartable(
+    step_fn: Callable[[Any, int], Any],
+    state: Any,
+    num_steps: int,
+    checkpointer=None,
+    checkpoint_every: int = 100,
+    start_step: Optional[int] = None,
+    detector: Optional[FailureDetector] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Tuple[Any, int]:
+    """Run ``state = step_fn(state, i)`` for ``i in [start, num_steps)`` with
+    checkpoint-based recovery.
+
+    * With a ``checkpointer`` (``tensorframes_tpu.checkpoint.Checkpointer``),
+      state is saved every ``checkpoint_every`` steps and — when
+      ``start_step`` is None — the run RESUMES from the latest checkpoint
+      if one exists (the restart-after-crash entry path: just rerun the
+      same driver).
+    * On a transient runtime failure, the last checkpointed state is
+      restored and the loop continues from there; ``detector`` governs
+      classification, backoff, and the restart budget.
+
+    Returns ``(final_state, steps_run_this_call)``.
+    """
+    detector = detector or FailureDetector()
+    step = start_step if start_step is not None else 0
+    if checkpointer is not None and start_step is None:
+        latest = checkpointer.latest_step()
+        if latest is not None:
+            state = checkpointer.restore(latest, target=state)
+            step = latest + 1
+            _log.info("resuming from checkpoint step %d", latest)
+    steps_run = 0
+    while step < num_steps:
+        try:
+            state = step_fn(state, step)
+        except BaseException as exc:  # noqa: BLE001 - classified below
+            delay = detector.on_failure(exc)
+            sleep(delay)
+            if checkpointer is not None:
+                latest = checkpointer.latest_step()
+                if latest is not None:
+                    state = checkpointer.restore(latest, target=state)
+                    step = latest + 1
+                    _log.info(
+                        "restored step %d after failure; resuming", latest
+                    )
+                    continue
+            # no checkpoint to fall back to: retry the same step
+            continue
+        if (
+            checkpointer is not None
+            and checkpoint_every > 0
+            and step % checkpoint_every == 0
+        ):
+            checkpointer.save(step, state, wait=True)
+        step += 1
+        steps_run += 1
+    return state, steps_run

@@ -1,0 +1,127 @@
+"""Failure detection / restartable-step recovery (SURVEY.md §5: the
+reference delegates to Spark task retry; the TPU equivalent is
+checkpoint-based step restart)."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.checkpoint import Checkpointer
+from tensorframes_tpu.resilience import (
+    FailureDetector,
+    RestartBudgetExceeded,
+    run_restartable,
+)
+
+
+class FakePreemption(RuntimeError):
+    def __init__(self):
+        super().__init__("DEADLINE EXCEEDED: slice has been terminated")
+
+
+def test_happy_path_counts_steps(tmp_path):
+    ck = Checkpointer(str(tmp_path / "ck"), keep=2)
+    state, n = run_restartable(
+        lambda s, i: {"w": s["w"] + 1.0},
+        {"w": np.float64(0.0)},
+        num_steps=10,
+        checkpointer=ck,
+        checkpoint_every=4,
+    )
+    assert n == 10
+    assert float(state["w"]) == 10.0
+    assert ck.latest_step() == 8
+    ck.close()
+
+
+def test_transient_failure_restores_from_checkpoint(tmp_path):
+    ck = Checkpointer(str(tmp_path / "ck"), keep=2)
+    fails = {"armed": True}
+
+    def step(s, i):
+        if i == 6 and fails["armed"]:
+            fails["armed"] = False
+            raise FakePreemption()
+        return {"w": s["w"] + 1.0}
+
+    slept = []
+    state, _ = run_restartable(
+        step,
+        {"w": np.float64(0.0)},
+        num_steps=10,
+        checkpointer=ck,
+        checkpoint_every=3,
+        sleep=slept.append,
+    )
+    # failure at step 6 restored step-3 checkpoint and replayed — the final
+    # value is exactly 10 increments' worth because state is step-indexed
+    assert float(state["w"]) == 10.0
+    assert slept == [1.0]
+    ck.close()
+
+
+def test_resume_from_latest_on_fresh_invocation(tmp_path):
+    ck = Checkpointer(str(tmp_path / "ck"), keep=2)
+    run_restartable(
+        lambda s, i: {"w": s["w"] + 1.0},
+        {"w": np.float64(0.0)},
+        num_steps=5,
+        checkpointer=ck,
+        checkpoint_every=2,
+    )
+    assert ck.latest_step() == 4
+    # crash-and-rerun: a fresh call resumes at step 5, not step 0
+    state, n = run_restartable(
+        lambda s, i: {"w": s["w"] + 1.0},
+        {"w": np.float64(0.0)},
+        num_steps=8,
+        checkpointer=ck,
+        checkpoint_every=2,
+    )
+    assert n == 3  # steps 5, 6, 7
+    assert float(state["w"]) == 8.0
+    ck.close()
+
+
+def test_fatal_error_not_retried():
+    calls = {"n": 0}
+
+    def step(s, i):
+        calls["n"] += 1
+        raise ValueError("shape mismatch: deterministic bug")
+
+    with pytest.raises(ValueError, match="deterministic"):
+        run_restartable(step, {}, num_steps=3, sleep=lambda _: None)
+    assert calls["n"] == 1
+
+
+def test_restart_budget_exceeded():
+    def step(s, i):
+        raise FakePreemption()
+
+    with pytest.raises(RestartBudgetExceeded):
+        run_restartable(
+            step,
+            {},
+            num_steps=3,
+            detector=FailureDetector(max_restarts=2, backoff_s=0.0),
+            sleep=lambda _: None,
+        )
+
+
+def test_detector_classification():
+    d = FailureDetector()
+    assert d.is_transient(RuntimeError("device UNAVAILABLE: preempted"))
+    assert d.is_transient(RuntimeError("collective timeout on mesh"))
+    assert not d.is_transient(ValueError("bad shape"))
+    assert not d.is_transient(RuntimeError("some random failure"))
+
+
+def test_backoff_grows():
+    d = FailureDetector(max_restarts=3, backoff_s=1.0, backoff_factor=2.0)
+    delays = [
+        d.on_failure(FakePreemption()),
+        d.on_failure(FakePreemption()),
+        d.on_failure(FakePreemption()),
+    ]
+    assert delays == [1.0, 2.0, 4.0]

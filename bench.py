@@ -68,9 +68,9 @@ def main() -> None:
     import tensorframes_tpu as tfs
     from tensorframes_tpu.models import inception
 
-    n_rows = 512
+    n_rows = 2048
     num_blocks = 4  # multiple blocks exercise the overlapped data plane
-    block_rows = n_rows // num_blocks
+    block_rows = n_rows // num_blocks  # 512/block: amortises dispatch syncs
     side = inception.INPUT_SIZE
 
     rng = np.random.RandomState(0)
@@ -95,9 +95,13 @@ def main() -> None:
         np.asarray(out.column("prediction").data)
         np.asarray(out.column("score").data)
 
-    # cold pass: compile (persistent-cached) + host->HBM transfer included
+    # cold pass, one SMALL block (128 rows): compile (persistent-cached) +
+    # host->HBM transfer included, sized to stay bounded when the remote
+    # link's bandwidth dips (observed 2-150 MB/s on the tunnel)
+    cold_rows = 128
+    cold_frame = tfs.TensorFrame.from_arrays({"image": images[:cold_rows]})
     t0 = time.perf_counter()
-    run_once(frame)
+    run_once(cold_frame)
     cold_s = time.perf_counter() - t0
 
     # steady state: the frame cached in HBM (tfs .cache(), the Spark
@@ -137,10 +141,11 @@ def main() -> None:
     peak = _PEAK_BF16.get(kind)
     mfu = (tflops * 1e12 / peak) if (tflops and peak) else None
 
-    # -- phase breakdown (one rep, reusing the Program's executable) ---------
+    # -- phase breakdown (one rep on a 128-row block, reusing the Program's
+    # executable; small block bounds the transfer-phase wall time) ----------
     phases = {}
     try:
-        blk = images[:block_rows]
+        blk = images[:cold_rows]
         t0 = time.perf_counter()
         dev = jax.device_put(blk)
         dev.block_until_ready()
@@ -198,7 +203,7 @@ def main() -> None:
         "vs_baseline": vs_baseline,
         "device": kind,
         "baseline": baseline_desc,
-        "cold_rows_per_s": round(n_rows / cold_s, 1),
+        "cold_rows_per_s": round(cold_rows / cold_s, 1),
     }
     if tflops is not None:
         result["achieved_tflops"] = round(tflops, 2)

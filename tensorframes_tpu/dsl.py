@@ -271,36 +271,21 @@ def _collect(fetches: Sequence[Node]) -> List[Node]:
     return order
 
 
-def build_program(
-    fetches: Sequence[Union[Node, Any]],
-    feed_dict: Optional[Dict[str, str]] = None,
-) -> Program:
-    """Lower DSL fetch nodes to a :class:`Program`.
-
-    Fetch nodes must be named (``.named("z")``) — the reference's requested
-    -fetches contract (``Node.hints``, ``dsl/Operation.scala:166-176``).
-    Anonymous interior nodes get deterministic generated names.
-    """
-    fetch_nodes = [f for f in fetches]
-    for f in fetch_nodes:
-        if not isinstance(f, Node):
-            raise DslError(f"fetches must be DSL nodes, got {type(f).__name__}")
-    order = _collect(fetch_nodes)
-
-    # name assignment: user names win, must be unique; anonymous fetches
-    # are an error (outputs need stable column names).  Generated names live
-    # in a local node->name map so building a program never mutates the
-    # user's Node objects (nodes shared between programs would otherwise
-    # collide on their first generated name).
+def _assign_names(
+    order: Sequence[Node], fetch_nodes: Sequence[Node]
+) -> Dict[int, str]:
+    """Name assignment: user names win, must be unique; anonymous fetches
+    are an error (outputs need stable column names).  Generated names live
+    in a local node->name map so building a program never mutates the
+    user's Node objects (nodes shared between programs would otherwise
+    collide on their first generated name)."""
     names: Dict[int, str] = {}
     used: Dict[str, Node] = {}
     counters: Dict[str, int] = {}
     for n in order:
         if n.name is not None:
             if n.name in used and used[n.name] is not n:
-                raise DslError(
-                    f"duplicate node name {n.name!r} in DSL graph"
-                )
+                raise DslError(f"duplicate node name {n.name!r} in DSL graph")
             used[n.name] = n
             names[n.id] = n.name
     for f in fetch_nodes:
@@ -320,6 +305,98 @@ def build_program(
                 candidate = f"{n.op}_{i}"
             names[n.id] = candidate
             used[candidate] = n
+    return names
+
+
+# DSL op tag -> TF op name, for GraphDef export (the reference's DSL emits
+# NodeDef protos directly, dsl/DslImpl.scala:143-157 / ProtoConversions)
+_TF_OPS = {
+    "identity": "Identity",
+    "add": "Add",
+    "sub": "Sub",
+    "mul": "Mul",
+    "div": "RealDiv",
+    "matmul": "MatMul",
+}
+_TF_REDUCE = {
+    "reduce_sum": "Sum",
+    "reduce_min": "Min",
+    "reduce_max": "Max",
+    "reduce_mean": "Mean",
+}
+
+
+def to_graphdef(fetches: Sequence[Node]) -> bytes:
+    """Export DSL fetch nodes as serialized TF GraphDef bytes.
+
+    The write-side mirror of the reference's DSL, which builds ``NodeDef``
+    protos and golden-tests them against python TF's output
+    (``dsl/DslImpl.scala:143-157``, ``dsl/ExtractNodes.scala:14-74``).  The
+    exported graph round-trips through ``graphdef.import_graphdef`` (our
+    golden axis, no TF install needed) and is consumable by TF tooling /
+    the bridge protocol.
+
+    Reductions need an explicit ``axis`` (the wire format encodes
+    ``reduction_indices`` as a Const input, which requires concrete axes).
+    """
+    from .graphdef.builder import GraphBuilder
+
+    fetch_nodes = list(fetches)
+    for f in fetch_nodes:
+        if not isinstance(f, Node):
+            raise DslError(f"fetches must be DSL nodes, got {type(f).__name__}")
+    order = _collect(fetch_nodes)
+    names = _assign_names(order, fetch_nodes)
+    g = GraphBuilder()
+    for n in order:
+        nm = names[n.id]
+        ins = [names[p.id] for p in n.parents]
+        if n.op == "placeholder":
+            g.placeholder(nm, n.attrs["dtype"], list(n.attrs["shape"]))
+        elif n.op == "const":
+            g.const(nm, n.attrs["value"])
+        elif n.op == "fill":
+            st = n.attrs["dtype"]
+            g.const(
+                nm,
+                np.full(
+                    tuple(n.attrs["shape"]), n.attrs["value"], st.np_dtype
+                ),
+            )
+        elif n.op in _TF_OPS:
+            g.op(_TF_OPS[n.op], nm, ins)
+        elif n.op in _TF_REDUCE:
+            axis = n.attrs.get("axis")
+            if axis is None:
+                raise DslError(
+                    f"{n.op} needs an explicit axis=[...] for GraphDef "
+                    f"export (reduction_indices must be concrete)"
+                )
+            ax = g.const(
+                f"{nm}/reduction_indices", np.asarray(axis, np.int32)
+            )
+            g.op(_TF_REDUCE[n.op], nm, ins + [ax])
+        else:  # pragma: no cover - every public constructor is mapped
+            raise DslError(f"DSL op {n.op!r} has no GraphDef lowering")
+    return g.to_bytes()
+
+
+def build_program(
+    fetches: Sequence[Union[Node, Any]],
+    feed_dict: Optional[Dict[str, str]] = None,
+) -> Program:
+    """Lower DSL fetch nodes to a :class:`Program`.
+
+    Fetch nodes must be named (``.named("z")``) — the reference's requested
+    -fetches contract (``Node.hints``, ``dsl/Operation.scala:166-176``).
+    Anonymous interior nodes get deterministic generated names.
+    """
+    fetch_nodes = [f for f in fetches]
+    for f in fetch_nodes:
+        if not isinstance(f, Node):
+            raise DslError(f"fetches must be DSL nodes, got {type(f).__name__}")
+    order = _collect(fetch_nodes)
+    names = _assign_names(order, fetch_nodes)
 
     placeholders = [n for n in order if n.op == "placeholder"]
     if not placeholders:

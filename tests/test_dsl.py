@@ -199,3 +199,59 @@ def test_build_program_does_not_mutate_shared_nodes():
     np.testing.assert_allclose(r["r"], (np.arange(3.0) + 1) + np.arange(3.0) * 2)
     np.testing.assert_allclose(r["s"], (np.arange(3.0) + 1) * np.arange(3.0) * 2)
     del p1, p2
+
+
+# -------------------------------------------------- GraphDef export ------
+
+
+def test_dsl_to_graphdef_round_trip():
+    """DSL graph -> wire GraphDef bytes -> importer -> same results as the
+    directly-lowered DSL program (the golden axis replacing the reference's
+    scala-vs-python-TF proto diff, ExtractNodes.scala:14-74)."""
+    from tensorframes_tpu.graphdef import import_graphdef, load_graphdef
+
+    x = dsl.placeholder("float64", [-1], name="x")
+    z = ((x * 2.0 + 1.0) / 4.0).named("z")
+    s = dsl.reduce_sum(x * x, axis=[0]).named("s")
+
+    gd = dsl.to_graphdef([z, s])
+    graph = load_graphdef(gd)
+    ops = {n.op for n in graph.nodes}
+    assert {"Placeholder", "Const", "Mul", "Add", "RealDiv", "Sum"} <= ops
+
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"x": np.arange(6.0)})
+    )
+    via_wire = tfs.map_blocks_trimmed(
+        import_graphdef(gd, fetches=["z"]), frame
+    )
+    direct = tfs.map_blocks_trimmed(dsl.build_program([z]), frame)
+    np.testing.assert_allclose(
+        np.asarray(via_wire.column("z").data),
+        np.asarray(direct.column("z").data),
+    )
+
+
+def test_dsl_to_graphdef_fill_and_matmul():
+    from tensorframes_tpu.graphdef import import_graphdef
+
+    m = dsl.placeholder("float64", [-1, 2], name="m")
+    w = dsl.fill([2, 3], 0.5)
+    out = dsl.matmul(m, w).named("out")
+    gd = dsl.to_graphdef([out])
+    p = import_graphdef(gd, fetches=["out"])
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"m": np.arange(8.0).reshape(4, 2)})
+    )
+    got = tfs.map_blocks(p, frame)
+    np.testing.assert_allclose(
+        np.asarray(got.column("out").data),
+        np.arange(8.0).reshape(4, 2) @ np.full((2, 3), 0.5),
+    )
+
+
+def test_dsl_to_graphdef_reduce_needs_axis():
+    x = dsl.placeholder("float64", [-1], name="x")
+    r = dsl.reduce_sum(x).named("r")
+    with pytest.raises(dsl.DslError, match="axis"):
+        dsl.to_graphdef([r])

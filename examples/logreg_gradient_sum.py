@@ -1,0 +1,75 @@
+"""Distributed gradient-sum logistic regression — BASELINE config #5.
+
+The reference path being replaced: per-partition TF sessions compute
+gradient partials, Spark's driver-side ``RDD.reduce`` sums them
+(``DebugRowOps.scala:503-526``), the driver updates weights, and a fresh
+graph ships every iteration.  Here each block collapses to one gradient row
+(``map_blocks_trimmed``, the map-side pre-reduction), ``reduce_blocks`` sums
+partials — one ICI allreduce under a ``MeshExecutor`` — and the frame stays
+cached in HBM across the whole run.
+
+Run: ``python examples/logreg_gradient_sum.py``
+"""
+
+import time
+
+import numpy as np
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.models import logistic_regression as lr
+
+
+def make_clicks(n=200_000, d=128, seed=0):
+    """Synthetic Criteo-shaped click data: dense features, {0,1} labels."""
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(d) / np.sqrt(d)
+    x = rng.randn(n, d).astype(np.float32)
+    logits = x @ w_true + 0.25 * rng.randn(n)
+    y = (logits > 0).astype(np.float32)
+    return x, y, w_true
+
+
+def main(n=200_000, d=128, iters=30, use_mesh=None):
+    x, y, w_true = make_clicks(n, d)
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays(
+            {"features": x, "label": y}, num_blocks=8
+        )
+    ).cache()
+
+    engine = None
+    if use_mesh is None:
+        import jax
+
+        use_mesh = len(jax.devices()) > 1
+    if use_mesh:
+        from tensorframes_tpu.parallel import MeshExecutor
+
+        engine = MeshExecutor(mode="per_block")
+
+    t0 = time.perf_counter()
+    params, losses = lr.fit(frame, num_iters=iters, lr=1.0, engine=engine)
+    train_s = time.perf_counter() - t0
+
+    acc = float((lr.predict(params, x) == y).mean())
+    cos = float(
+        np.dot(np.asarray(params["w"]), w_true)
+        / (np.linalg.norm(params["w"]) * np.linalg.norm(w_true))
+    )
+    shards = (
+        f"mesh/{engine.mesh.shape[engine.axis]} shards"
+        if engine
+        else "single device"
+    )
+    print(
+        f"{iters} distributed gradient-sum steps over {n} rows x {d} "
+        f"features in {train_s:.2f}s ({shards})"
+    )
+    print(
+        f"loss {losses[0]:.4f} -> {losses[-1]:.4f}; train acc {acc:.4f}; "
+        f"cos(w, w_true) {cos:.4f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ import numpy as np
 from ..frame import TensorFrame
 from ..ops import map_blocks, reduce_blocks
 from ..ops.engine import Executor
+from ..program import Program
 
 
 def init(num_features: int, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
@@ -54,21 +55,27 @@ def grad_program(params):
     ``grad_w`` [1, d], ``grad_b`` [1], ``count`` [1], ``loss`` [1] —
     summable partials, the UDAF-compatible algebraic form the reference's
     ``aggregate`` contract requires (``Operations.scala:110-126``).
+
+    ``w``/``b`` are Program *params* (traced arguments): the training loop
+    steps with ``update_params`` and reuses one compiled executable — the
+    reference re-builds and re-broadcasts its gradient graph every
+    iteration (``kmeans_demo.py:68-80``'s pattern).
     """
 
-    def fn(features, label):
-        g = jax.grad(_loss)(params, features, label)
-        gw, gb = g["w"], g["b"]
-        loss = _loss(params, features, label)
+    def fn(features, label, w, b):
+        p = {"w": w, "b": b}
+        loss, g = jax.value_and_grad(_loss)(p, features, label)
         n = features.shape[0]
         return {
-            "grad_w": gw[None, :],
-            "grad_b": gb[None],
+            "grad_w": g["w"][None, :],
+            "grad_b": g["b"][None],
             "count": jnp.full((1,), n, dtype=features.dtype),
             "loss": loss[None],
         }
 
-    return fn
+    return Program.wrap(
+        fn, params={"w": params["w"], "b": params["b"]}
+    )
 
 
 def _sum_program():
@@ -88,13 +95,21 @@ def gradient_step(
     frame: TensorFrame,
     lr: float,
     engine: Optional[Executor] = None,
+    _programs: Optional[dict] = None,
 ) -> Tuple[Dict[str, jnp.ndarray], float]:
     """One full distributed step: per-block grad partials -> cross-block sum
-    -> SGD update.  Returns (new_params, mean_loss)."""
-    partials = map_blocks(
-        grad_program(params), frame, trim=True, engine=engine
-    )
-    summed = reduce_blocks(_sum_program(), partials, engine=engine)
+    -> SGD update.  Returns (new_params, mean_loss).
+
+    ``_programs``: compiled-program cache threaded by ``fit`` so iterations
+    update params in place instead of re-tracing."""
+    progs = _programs if _programs is not None else {}
+    if "grad" not in progs:
+        progs["grad"] = grad_program(params)
+        progs["sum"] = Program.wrap(_sum_program())
+    else:
+        progs["grad"].update_params(w=params["w"], b=params["b"])
+    partials = map_blocks(progs["grad"], frame, trim=True, engine=engine)
+    summed = reduce_blocks(progs["sum"], partials, engine=engine)
     n = float(summed["count"])
     gw = jnp.asarray(summed["grad_w"]) / n
     gb = jnp.asarray(summed["grad_b"]) / n
@@ -129,8 +144,11 @@ def fit(
     d = frame.schema["features"].cell_shape[0]
     params = init(d)
     losses = []
+    progs: dict = {}  # compile once, update_params per iteration
     for _ in range(num_iters):
-        params, loss = gradient_step(params, frame, lr, engine=engine)
+        params, loss = gradient_step(
+            params, frame, lr, engine=engine, _programs=progs
+        )
         losses.append(loss)
     return params, losses
 

@@ -35,7 +35,12 @@ from .ops import (
     reduce_blocks,
     reduce_rows,
 )
-from .program import GraphNodeSummary, Program, ProgramError
+from .program import (
+    GraphNodeSummary,
+    Program,
+    ProgramError,
+    deserialize_program,
+)
 from .schema import ColumnInfo, Schema, SchemaError
 from .shape import Shape, ShapeError, UNKNOWN
 
@@ -81,4 +86,5 @@ __all__ = [
     "Program",
     "ProgramError",
     "GraphNodeSummary",
+    "deserialize_program",
 ]

@@ -158,12 +158,13 @@ def fit(
     else:
         rng = np.random.RandomState(seed)
         chosen = [rng.randint(len(pts))]
+        # greedy farthest-point: track the running min-distance to the
+        # chosen set and fold in only the newest center — O(n*d) per center
+        # (the naive n x k x d broadcast is gigabytes at demo scale)
+        d2 = ((pts - pts[chosen[0]]) ** 2).sum(-1)
         for _ in range(k - 1):
-            d2 = np.min(
-                ((pts[:, None, :] - pts[chosen][None, :, :]) ** 2).sum(-1),
-                axis=1,
-            )
             chosen.append(int(np.argmax(d2)))
+            np.minimum(d2, ((pts - pts[chosen[-1]]) ** 2).sum(-1), out=d2)
         centers = pts[chosen].copy()
     programs: dict = {}
     for _ in range(num_iters):

@@ -58,6 +58,34 @@ class GraphNodeSummary:
         return f"{self.name}[{role}]: {self.scalar_type}{self.shape}"
 
 
+def deserialize_program(data: bytes) -> "Program":
+    """Rehydrate a :meth:`Program.serialize` artifact.
+
+    The artifact is self-contained (params frozen in, shapes possibly
+    symbolic): the deserialized program runs on any backend jax supports,
+    the way the reference's broadcast graph bytes run in any executor.
+    Block-level semantics only — the frozen executable cannot be re-vmapped,
+    so feed it to ``map_blocks``/``reduce_*``, not ``map_rows``."""
+    import json
+
+    from jax import export as jexp
+
+    sep = data.index(b"\x00")
+    header = json.loads(data[:sep].decode())
+    if header.get("format") != "tfs-program-v1":
+        raise ProgramError(
+            f"not a serialized tensorframes program (format="
+            f"{header.get('format')!r})"
+        )
+    exported = jexp.deserialize(data[sep + 1 :])
+    input_names = header["inputs"]
+
+    def fn(**kwargs):
+        return exported.call({n: kwargs[n] for n in input_names})
+
+    return Program(fn, input_names, header["fetches"])
+
+
 class Program:
     """A tensor program with named inputs and named outputs.
 
@@ -407,6 +435,74 @@ class Program:
                 jax.jit(build_raw())
             )
         return self._derived[key]
+
+    # -- serialization -------------------------------------------------------
+
+    def serialize(self, input_specs: Mapping[str, Any]) -> bytes:
+        """Freeze into a portable program artifact (StableHLO via
+        ``jax.export``).
+
+        The reference's program transport is frozen GraphDef bytes shipped
+        to executors (``SerializedGraph``, ``TensorFlowOps.scala:21-61``);
+        the XLA-native equivalent is serialized StableHLO: params are baked
+        in as constants (a *frozen* program), and Unknown (-1) dims become
+        symbolic — every Unknown lead dim shares one ``rows`` symbol (all
+        columns of a block have the same row count), so one artifact serves
+        any block size without recompiling the export.
+
+        ``input_specs``: input name -> (ScalarType, Shape), Unknown dims
+        allowed.  Round-trip via :func:`deserialize_program`.
+        """
+        import json
+
+        from jax import export as jexp
+
+        shapes: Dict[str, Shape] = {}
+        stypes: Dict[str, Any] = {}
+        for n in self._input_names:
+            if n not in input_specs:
+                raise ProgramError(
+                    f"serialize: no spec for program input {n!r}; got "
+                    f"specs for {sorted(input_specs)}"
+                )
+            spec = input_specs[n]
+            if isinstance(spec, jax.ShapeDtypeStruct):
+                shapes[n] = Shape(spec.shape)
+                stypes[n] = spec.dtype
+            else:
+                st, shape = spec
+                shapes[n] = Shape(shape)
+                stypes[n] = st.np_dtype
+
+        n_cell_syms = sum(
+            sum(1 for d in s.dims[1:] if d == UNKNOWN)
+            for s in shapes.values()
+        )
+        sym_names = ["rows"] + [f"u{i}" for i in range(n_cell_syms)]
+        syms = list(jexp.symbolic_shape(", ".join(sym_names)))
+        rows_sym, cell_syms = syms[0], syms[1:]
+        next_cell = iter(cell_syms)
+        structs = {}
+        for n in self._input_names:
+            dims = []
+            for i, d in enumerate(shapes[n]):
+                if d != UNKNOWN:
+                    dims.append(d)
+                elif i == 0:
+                    dims.append(rows_sym)
+                else:
+                    dims.append(next(next_cell))
+            structs[n] = jax.ShapeDtypeStruct(tuple(dims), stypes[n])
+
+        exported = jexp.export(jax.jit(lambda ins: self.call(ins)))(structs)
+        header = json.dumps(
+            {
+                "format": "tfs-program-v1",
+                "inputs": self._input_names,
+                "fetches": self._fetches or self.fetches,
+            }
+        ).encode()
+        return header + b"\x00" + exported.serialize()
 
     # -- analysis ------------------------------------------------------------
 

@@ -476,3 +476,49 @@ def test_aggregate_scale_smoke():
     np.testing.assert_allclose(
         np.asarray(out.to_arrays()["v"]), np.full(n_keys, per_key * 1.0)
     )
+
+
+# ------------------------------------------- program serialization ------
+
+
+def test_program_serialize_round_trip():
+    """Program -> StableHLO artifact -> Program: the SerializedGraph
+    transport analog (TensorFlowOps.scala:21-61), with a symbolic rows dim
+    so one artifact serves any block size."""
+    from tensorframes_tpu import dtypes as dt
+    from tensorframes_tpu.program import deserialize_program
+
+    p = tfs.Program.wrap(
+        lambda x, scale: {"z": x * scale + 1.0},
+        params={"scale": np.float64(3.0)},
+    )
+    data = p.serialize({"x": (dt.by_name("float64"), (-1, 2))})
+    assert isinstance(data, bytes) and len(data) > 100
+
+    back = deserialize_program(data)
+    assert back.input_names == ["x"]  # params are frozen into the artifact
+    for n in (3, 5):  # symbolic rows: no per-size re-export
+        f = frame({"x": np.arange(float(n * 2)).reshape(n, 2)})
+        out = tfs.map_blocks(back, f)
+        np.testing.assert_allclose(
+            np.asarray(out.column("z").data),
+            np.arange(float(n * 2)).reshape(n, 2) * 3.0 + 1.0,
+        )
+
+
+def test_program_serialize_reduce_blocks():
+    from tensorframes_tpu import dtypes as dt
+    from tensorframes_tpu.program import deserialize_program
+
+    p = tfs.Program.wrap(lambda x_input: {"x": x_input.sum(0)})
+    data = p.serialize({"x_input": (dt.by_name("float64"), (-1,))})
+    back = deserialize_program(data)
+    got = tfs.reduce_blocks(back, frame({"x": np.arange(10.0)}, blocks=3))
+    assert got["x"] == pytest.approx(45.0)
+
+
+def test_deserialize_rejects_garbage():
+    from tensorframes_tpu.program import deserialize_program
+
+    with pytest.raises((tfs.ProgramError, ValueError)):
+        deserialize_program(b'{"format": "nope"}\x00junk')

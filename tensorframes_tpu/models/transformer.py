@@ -51,7 +51,9 @@ class TransformerConfig:
     rope_theta: float = 10_000.0
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     param_dtype: Any = jnp.float32
-    attn_impl: str = "full"  # "full" | "ring" (sp-distributed)
+    # "full" | "flash" (Pallas, sp=1) | "ring" (sp-distributed) |
+    # "ring_flash" (ring with the Pallas local step)
+    attn_impl: str = "full"
     remat: bool = False  # rematerialise blocks (jax.checkpoint)
 
     def __post_init__(self):
@@ -211,8 +213,11 @@ def _block(
         v = jnp.repeat(v, h // kvh, axis=2)
     from ..parallel.ring import full_attention, ring_attention
 
-    if cfg.attn_impl == "ring":
-        att = ring_attention(q, k, v, causal=True)
+    if cfg.attn_impl in ("ring", "ring_flash"):
+        att = ring_attention(
+            q, k, v, causal=True,
+            impl="flash" if cfg.attn_impl == "ring_flash" else "xla",
+        )
     elif cfg.attn_impl == "flash":
         # Pallas online-softmax kernel (O(L) HBM traffic); row-major causal
         # positions — the sp == 1 operating point (parallel/flash.py)

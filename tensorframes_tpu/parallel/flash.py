@@ -176,6 +176,164 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     return jnp.swapaxes(out, 1, 2)  # [B, Lq, H, Dh]
 
 
+# ---------------------------------------------------------------------------
+# ring-attention local step (carry-in/carry-out online softmax)
+# ---------------------------------------------------------------------------
+
+
+def _ring_step_kernel(
+    qo_ref,
+    ko_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_ref,
+    l_ref,
+    o_out,
+    m_out,
+    l_out,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _load_carry():
+        m_scr[:] = m_ref[0]
+        l_scr[:] = l_ref[0]
+        acc_scr[:] = o_ref[0]
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    s = (
+        jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        * np.float32(scale)
+    )
+    if causal:
+        q_idx = qo_ref[0, 0] + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_idx = ko_ref[0, 0] + kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_idx >= k_idx, s, _NEG_INF)
+
+    m_prev = m_scr[:]
+    l_prev = l_scr[:]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    m_safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+    p = jnp.exp(s - m_safe)
+    alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+    m_scr[:] = m_new
+    l_scr[:] = alpha * l_prev + p.sum(axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+
+    @pl.when(kj == pl.num_programs(2) - 1)
+    def _store_carry():
+        o_out[0] = acc_scr[:]
+        m_out[0] = m_scr[:]
+        l_out[0] = l_scr[:]
+
+
+def _chunk_block(c: int) -> int:
+    for b in (128, 64, 32, 16, 8):
+        if c % b == 0:
+            return b
+    return c
+
+
+def flash_ring_step(
+    q, k, v, o, m, l, q_off, k_off,
+    causal: bool = True,
+    interpret: Optional[bool] = None,
+):
+    """One ring-attention step as a Pallas kernel: fold the K/V chunk at
+    global offset ``k_off`` into the running online-softmax carry.
+
+    The XLA step (``ring.py::_online_softmax_step``) materialises the
+    [B, H, C, C] score block in HBM every ring hop; this kernel streams it
+    through VMEM — O(C) HBM traffic per hop, the flash recurrence with the
+    (o numerator f32, m row-max, l denominator) carry travelling between
+    hops instead of living in scratch.
+
+    q/k/v: [B, C, H, Dh]; o: [B, C, H, Dh] f32; m/l: [B, H, C] f32;
+    ``q_off``/``k_off``: traced int32 global positions of the chunks.
+    Returns the updated (o, m, l).
+    """
+    B, C, H, Dh = q.shape
+    scale = 1.0 / np.sqrt(Dh)
+    bq = _chunk_block(C)
+    bk = bq
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def to_bh(x):  # [B, C, H, D] -> [B*H, C, D]
+        return jnp.swapaxes(x, 1, 2).reshape(B * H, C, x.shape[-1])
+
+    qb, kb, vb, ob = to_bh(q), to_bh(k), to_bh(v), to_bh(o)
+    # m/l travel as [BH, C, 1]: TPU block tiling needs the last two dims to
+    # divide (8, 128) or equal the array dims — a trailing 1 satisfies that
+    # and matches the kernel's (bq, 1) scratch layout exactly
+    mb = m.reshape(B * H, C, 1)
+    lb = l.reshape(B * H, C, 1)
+    qo = jnp.reshape(jnp.asarray(q_off, jnp.int32), (1, 1))
+    ko = jnp.reshape(jnp.asarray(k_off, jnp.int32), (1, 1))
+
+    grid = (B * H, C // bq, C // bk)
+    smem = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0), memory_space=pltpu.SMEM)
+    carry_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+    o_new, m_new, l_new = pl.pallas_call(
+        functools.partial(
+            _ring_step_kernel,
+            scale=scale,
+            causal=causal,
+            block_q=bq,
+            block_k=bk,
+        ),
+        grid=grid,
+        in_specs=[
+            smem,
+            smem,
+            pl.BlockSpec((1, bq, Dh), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, Dh), lambda b, i, j: (b, i, 0)),
+            carry_spec,
+            carry_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, Dh), lambda b, i, j: (b, i, 0)),
+            carry_spec,
+            carry_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, C, Dh), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, C, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, C, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, Dh), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qo, ko, qb, kb, vb, ob, mb, lb)
+
+    o_out = jnp.swapaxes(o_new.reshape(B, H, C, Dh), 1, 2)
+    return o_out, m_new.reshape(B, H, C), l_new.reshape(B, H, C)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(
     q,

@@ -88,7 +88,7 @@ def _online_softmax_step(o, m, l, s, v, dtype):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_local(q_c, k_c, v_c, *, axis, sp, causal, scale):
+def _fwd_local(q_c, k_c, v_c, *, axis, sp, causal, scale, impl="xla"):
     dtype = q_c.dtype
     ring_perm = [(i, (i + 1) % sp) for i in range(sp)]
     B, C, H, Dh = q_c.shape
@@ -102,8 +102,19 @@ def _fwd_local(q_c, k_c, v_c, *, axis, sp, causal, scale):
     def step(i, carry):
         o, m, l, k_cur, v_cur = carry
         src = (my - i) % sp
-        s = _scores(q_c, k_cur, scale, causal, q_pos, src * C + jnp.arange(C))
-        o, m, l = _online_softmax_step(o, m, l, s, v_cur, dtype)
+        if impl == "flash":
+            # Pallas local step: the [B, H, C, C] score block stays in VMEM
+            # (flash.py::flash_ring_step) instead of hitting HBM every hop
+            from .flash import flash_ring_step
+
+            o, m, l = flash_ring_step(
+                q_c, k_cur, v_cur, o, m, l, my * C, src * C, causal
+            )
+        else:
+            s = _scores(
+                q_c, k_cur, scale, causal, q_pos, src * C + jnp.arange(C)
+            )
+            o, m, l = _online_softmax_step(o, m, l, s, v_cur, dtype)
         k_nxt = jax.lax.ppermute(k_cur, axis, ring_perm)
         v_nxt = jax.lax.ppermute(v_cur, axis, ring_perm)
         return o, m, l, k_nxt, v_nxt
@@ -163,19 +174,25 @@ def _bwd_local(q_c, k_c, v_c, o_c, lse_c, do_c, *, axis, sp, causal, scale):
 
 
 @functools.lru_cache(maxsize=None)
-def _manual_core(axis: str, sp: int, causal: bool, scale: float):
+def _manual_core(
+    axis: str, sp: int, causal: bool, scale: float, impl: str = "xla"
+):
     """custom_vjp core over LOCAL chunks (cached so repeated traces reuse
-    one custom_vjp object and its rules)."""
+    one custom_vjp object and its rules).  ``impl`` selects the forward's
+    local step ("xla" | "flash" Pallas kernel); the hand-written backward
+    ring is impl-independent (it only consumes the saved (out, lse))."""
 
     @jax.custom_vjp
     def core(q_c, k_c, v_c):
         return _fwd_local(
-            q_c, k_c, v_c, axis=axis, sp=sp, causal=causal, scale=scale
+            q_c, k_c, v_c,
+            axis=axis, sp=sp, causal=causal, scale=scale, impl=impl,
         )[0]
 
     def core_fwd(q_c, k_c, v_c):
         out, lse = _fwd_local(
-            q_c, k_c, v_c, axis=axis, sp=sp, causal=causal, scale=scale
+            q_c, k_c, v_c,
+            axis=axis, sp=sp, causal=causal, scale=scale, impl=impl,
         )
         return out, (q_c, k_c, v_c, out, lse)
 
@@ -197,11 +214,12 @@ def ring_attention_manual(
     sp: int,
     causal: bool = True,
     axis: str = "sp",
+    impl: str = "xla",
 ) -> jnp.ndarray:
     """Ring attention core for callers ALREADY inside an ``axis``-manual
     region: q/k/v are this device's contiguous [B, C, H, Dh] chunks."""
     scale = float(1.0 / np.sqrt(q_c.shape[-1]))
-    return _manual_core(axis, sp, causal, scale)(q_c, k_c, v_c)
+    return _manual_core(axis, sp, causal, scale, impl)(q_c, k_c, v_c)
 
 
 # ---------------------------------------------------------------------------
@@ -216,6 +234,7 @@ def ring_attention(
     causal: bool = True,
     axis: str = "sp",
     mesh: Optional[jax.sharding.Mesh] = None,
+    impl: str = "xla",
 ) -> jnp.ndarray:
     """Exact attention over a globally [B, L, H, Dh] q/k/v, sequence-sharded
     on ``axis``.  Returns [B, L, H, Dh] with q's dtype and sharding.
@@ -237,10 +256,10 @@ def ring_attention(
     axis_types = dict(zip(mesh.axis_names, mesh.axis_types))
     if axis_types.get(axis) == jax.sharding.AxisType.Manual:
         # already inside an sp-manual region: inputs are local chunks
-        return ring_attention_manual(q, k, v, sp, causal, axis)
+        return ring_attention_manual(q, k, v, sp, causal, axis, impl)
 
     scale = float(1.0 / np.sqrt(q.shape[-1]))
-    core = _manual_core(axis, sp, causal, scale)
+    core = _manual_core(axis, sp, causal, scale, impl)
     spec = P(None, axis, None, None)
     return jax.shard_map(
         core,

@@ -102,19 +102,32 @@ def _fwd_local(q_c, k_c, v_c, *, axis, sp, causal, scale, impl="xla"):
     def step(i, carry):
         o, m, l, k_cur, v_cur = carry
         src = (my - i) % sp
-        if impl == "flash":
-            # Pallas local step: the [B, H, C, C] score block stays in VMEM
-            # (flash.py::flash_ring_step) instead of hitting HBM every hop
-            from .flash import flash_ring_step
 
-            o, m, l = flash_ring_step(
-                q_c, k_cur, v_cur, o, m, l, my * C, src * C, causal
-            )
-        else:
+        def fold(oml):
+            o, m, l = oml
+            if impl == "flash":
+                # Pallas local step: the [B, H, C, C] score block stays in
+                # VMEM (flash.py::flash_ring_step) instead of hitting HBM
+                return flash_ring_step(
+                    q_c, k_cur, v_cur, o, m, l, my * C, src * C, causal
+                )
             s = _scores(
                 q_c, k_cur, scale, causal, q_pos, src * C + jnp.arange(C)
             )
-            o, m, l = _online_softmax_step(o, m, l, s, v_cur, dtype)
+            return _online_softmax_step(o, m, l, s, v_cur, dtype)
+
+        if impl == "flash":
+            from .flash import flash_ring_step
+        if causal:
+            # contiguous chunks: a K/V block from a strictly-later chunk is
+            # fully masked — skip its matmuls (the ppermute rotation still
+            # runs, so the ring schedule is unchanged); ~2x fewer attention
+            # FLOPs at large sp
+            o, m, l = jax.lax.cond(
+                src <= my, fold, lambda oml: oml, (o, m, l)
+            )
+        else:
+            o, m, l = fold((o, m, l))
         k_nxt = jax.lax.ppermute(k_cur, axis, ring_perm)
         v_nxt = jax.lax.ppermute(v_cur, axis, ring_perm)
         return o, m, l, k_nxt, v_nxt
@@ -146,23 +159,41 @@ def _bwd_local(q_c, k_c, v_c, o_c, lse_c, do_c, *, axis, sp, causal, scale):
     def step(i, carry):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
         src = (my - i) % sp
-        s = _scores(q_c, k_cur, scale, causal, q_pos, src * C + jnp.arange(C))
-        p = jnp.where(
-            jnp.isneginf(s), 0.0, jnp.exp(s - lse_safe[..., None])
-        )  # [B, H, Lq, Lk] f32
-        dv_cur = dv_cur + jnp.einsum(
-            "bhqk,bqhd->bkhd", p, do32, preferred_element_type=jnp.float32
-        )
-        dp = jnp.einsum(
-            "bqhd,bkhd->bhqk", do_c, v_cur, preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - D[..., None]) * scale
-        dq = dq + jnp.einsum(
-            "bhqk,bkhd->bqhd", ds, k_cur, preferred_element_type=jnp.float32
-        )
-        dk_cur = dk_cur + jnp.einsum(
-            "bhqk,bqhd->bkhd", ds, q_c, preferred_element_type=jnp.float32
-        )
+
+        def fold(grads):
+            dq, dk_cur, dv_cur = grads
+            s = _scores(
+                q_c, k_cur, scale, causal, q_pos, src * C + jnp.arange(C)
+            )
+            p = jnp.where(
+                jnp.isneginf(s), 0.0, jnp.exp(s - lse_safe[..., None])
+            )  # [B, H, Lq, Lk] f32
+            dv_cur = dv_cur + jnp.einsum(
+                "bhqk,bqhd->bkhd", p, do32, preferred_element_type=jnp.float32
+            )
+            dp = jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                do_c,
+                v_cur,
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - D[..., None]) * scale
+            dq = dq + jnp.einsum(
+                "bhqk,bkhd->bqhd", ds, k_cur, preferred_element_type=jnp.float32
+            )
+            dk_cur = dk_cur + jnp.einsum(
+                "bhqk,bqhd->bkhd", ds, q_c, preferred_element_type=jnp.float32
+            )
+            return dq, dk_cur, dv_cur
+
+        if causal:
+            # fully-masked hop (strictly-later K/V chunk): all its gradient
+            # contributions are zero — skip the matmuls, keep the rotation
+            dq, dk_cur, dv_cur = jax.lax.cond(
+                src <= my, fold, lambda g: g, (dq, dk_cur, dv_cur)
+            )
+        else:
+            dq, dk_cur, dv_cur = fold((dq, dk_cur, dv_cur))
         k_nxt = jax.lax.ppermute(k_cur, axis, ring_perm)
         v_nxt = jax.lax.ppermute(v_cur, axis, ring_perm)
         dk_nxt = jax.lax.ppermute(dk_cur, axis, ring_perm)

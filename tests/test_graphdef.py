@@ -467,3 +467,45 @@ def test_frozen_convnet_scoring_end_to_end():
     )
     # passthrough column (non-trimmed map keeps inputs)
     assert "image_data" in out.column_names
+
+
+def test_frozen_mlp_scored_via_map_rows():
+    """BASELINE config #3: per-row inference of a frozen MLP GraphDef (the
+    MNIST-style read_image.py flow, row variant) — the cell-level program is
+    vmapped over rows by the engine."""
+    rng = np.random.RandomState(7)
+    d, h, classes = 16, 32, 10
+    w1 = rng.randn(d, h).astype(np.float32) * 0.3
+    b1 = rng.randn(h).astype(np.float32) * 0.1
+    w2 = rng.randn(h, classes).astype(np.float32) * 0.3
+    b2 = rng.randn(classes).astype(np.float32) * 0.1
+
+    g = GraphBuilder()
+    # cell-level graph: one example [1, d] per row (MatMul needs rank 2)
+    g.placeholder("pixels", "float32", [1, d])
+    g.const("w1", w1)
+    g.op("MatMul", "h1", ["pixels", "w1"])
+    g.const("b1", b1)
+    g.op("BiasAdd", "h1b", ["h1", "b1"])
+    g.op("Relu", "act", ["h1b"])
+    g.const("w2", w2)
+    g.op("MatMul", "h2", ["act", "w2"])
+    g.const("b2", b2)
+    g.op("BiasAdd", "logits", ["h2", "b2"])
+    g.const("axis", np.int32(1))
+    g.op("ArgMax", "prediction", ["logits", "axis"])
+
+    n = 6
+    x = rng.randn(n, 1, d).astype(np.float32)
+    frame_rows = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"image_data": x})
+    )
+    p = import_graphdef(
+        g.build(), fetches=["prediction"], inputs={"pixels": "image_data"}
+    )
+    out = tfs.map_rows(p, frame_rows)
+    logits = np.maximum(x[:, 0] @ w1 + b1, 0) @ w2 + b2
+    np.testing.assert_array_equal(
+        np.asarray(out.column("prediction").data).reshape(n),
+        logits.argmax(1),
+    )

@@ -26,7 +26,7 @@ class BridgeError(RuntimeError):
 class BridgeClient:
     """Connects to a :class:`~tensorframes_tpu.bridge.server.BridgeServer`."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str, port: int):
         self._sock = socket.create_connection((host, port))
         self._rfile = self._sock.makefile("rb")
         self._wfile = self._sock.makefile("wb")

@@ -46,10 +46,7 @@ class _Session:
     # -- methods (the RPC surface) ------------------------------------------
 
     def create_frame(self, columns: Dict[str, Any], num_blocks: int = 1):
-        data = {}
-        for name, v in columns.items():
-            data[name] = v if isinstance(v, np.ndarray) else v
-        frame = TensorFrame.from_arrays(data, num_blocks=num_blocks)
+        frame = TensorFrame.from_arrays(dict(columns), num_blocks=num_blocks)
         fid = self.register(frame)
         return {"frame_id": fid, "schema": self._schema(frame)}
 

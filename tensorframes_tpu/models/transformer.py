@@ -269,6 +269,12 @@ def apply(
     stack runs (default sequential ``apply_blocks``; the training layer
     passes the GPipe pipeline, ``train.pipelined_blocks``)."""
     B, L = tokens.shape
+    if positions is not None and cfg.attn_impl == "flash":
+        raise ValueError(
+            "attn_impl='flash' masks with row-major arange positions and "
+            "cannot honour custom `positions`; pass positions=None or use "
+            "attn_impl='full'/'ring'"
+        )
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
     if blocks_runner is None:

@@ -51,9 +51,10 @@ def _flash_kernel(
     causal: bool,
     block_q: int,
     block_k: int,
-    seq_q: int,
     seq_k: int,
 ):
+    # padded QUERY rows are never masked here: their garbage outputs are
+    # sliced off by the [:Lq] in _flash_fwd_impl, so only keys need seq_k
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -153,7 +154,6 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
             causal=causal,
             block_q=bq,
             block_k=bk,
-            seq_q=Lq,
             seq_k=Lk,
         ),
         grid=grid,

@@ -83,7 +83,9 @@ def deserialize_program(data: bytes) -> "Program":
     def fn(**kwargs):
         return exported.call({n: kwargs[n] for n in input_names})
 
-    return Program(fn, input_names, header["fetches"])
+    return Program(
+        fn, input_names, header["fetches"], header.get("feed") or None
+    )
 
 
 class Program:
@@ -500,6 +502,7 @@ class Program:
                 "format": "tfs-program-v1",
                 "inputs": self._input_names,
                 "fetches": self._fetches or self.fetches,
+                "feed": self._feed,
             }
         ).encode()
         return header + b"\x00" + exported.serialize()

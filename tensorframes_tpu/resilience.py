@@ -69,9 +69,7 @@ class FailureDetector:
         self.restarts = 0
 
     def is_transient(self, exc: BaseException) -> bool:
-        if isinstance(exc, _FATAL_TYPES) and not isinstance(
-            exc, FloatingPointError
-        ):
+        if isinstance(exc, _FATAL_TYPES):
             return False
         text = f"{type(exc).__name__}: {exc}".lower()
         return any(m in text for m in _TRANSIENT_MARKERS)

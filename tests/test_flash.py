@@ -218,3 +218,21 @@ def test_transformer_ring_flash_matches_ring(devices):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4
     )
+
+
+def test_flash_rejects_custom_positions():
+    import dataclasses
+
+    from tensorframes_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=17, d_model=8, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=16, max_seq=8, dtype=jnp.float32, attn_impl="flash",
+    )
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 17)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32) + 4, (1, 8))
+    with pytest.raises(ValueError, match="row-major"):
+        tfm.apply(params, toks, cfg, positions=pos)
+    # default positions stay fine
+    assert tfm.apply(params, toks, cfg).shape == (1, 8, 17)

@@ -522,3 +522,20 @@ def test_deserialize_rejects_garbage():
 
     with pytest.raises((tfs.ProgramError, ValueError)):
         deserialize_program(b'{"format": "nope"}\x00junk')
+
+
+def test_program_serialize_preserves_feed_dict():
+    from tensorframes_tpu import dtypes as dt
+    from tensorframes_tpu.program import deserialize_program
+
+    p = tfs.Program.wrap(
+        lambda x: {"z": x + 1.0}, feed_dict={"x": "colA"}
+    )
+    back = deserialize_program(
+        p.serialize({"x": (dt.by_name("float64"), (-1,))})
+    )
+    assert back.column_for_input("x") == "colA"
+    out = tfs.map_blocks(back, frame({"colA": np.arange(4.0)}))
+    np.testing.assert_allclose(
+        np.asarray(out.column("z").data), np.arange(4.0) + 1.0
+    )

@@ -57,13 +57,31 @@ def decode_value(v: Any) -> Any:
     return v
 
 
+# One message must fit in memory (whole-line JSON framing); cap it so a
+# single oversized/malicious request cannot exhaust the server (ADVICE r2).
+# 256 MiB ≈ a 190 MB tensor after base64 — far above any control-plane
+# message, below any plausible memory budget.
+MAX_MESSAGE_BYTES = 256 * 1024 * 1024
+
+
 def write_message(sock_file, msg: dict) -> None:
-    sock_file.write(json.dumps(msg).encode() + b"\n")
+    data = json.dumps(msg).encode() + b"\n"
+    if len(data) > MAX_MESSAGE_BYTES:
+        raise ValueError(
+            f"bridge message of {len(data)} bytes exceeds the "
+            f"{MAX_MESSAGE_BYTES}-byte cap; move bulk data out of band "
+            f"(the bridge is a control plane, not a bulk transport)"
+        )
+    sock_file.write(data)
     sock_file.flush()
 
 
 def read_message(sock_file) -> dict:
-    line = sock_file.readline()
+    line = sock_file.readline(MAX_MESSAGE_BYTES + 1)
     if not line:
         raise ConnectionError("bridge peer closed the connection")
+    if len(line) > MAX_MESSAGE_BYTES:
+        raise ConnectionError(
+            f"bridge message exceeds the {MAX_MESSAGE_BYTES}-byte cap"
+        )
     return json.loads(line)

@@ -171,12 +171,29 @@ class _Handler(socketserver.StreamRequestHandler):
 
 
 class BridgeServer(socketserver.ThreadingTCPServer):
-    """Localhost TCP bridge server; one session per connection."""
+    """Localhost TCP bridge server; one session per connection.
+
+    The protocol executes client-supplied programs and is UNauthenticated —
+    it is a local IPC seam (the analog of the reference's in-process Py4J
+    gateway), not a network service.  Binding a non-loopback address
+    therefore requires ``allow_remote=True``, an explicit statement that
+    the network path is trusted (e.g. inside a pod's private fabric)."""
 
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, engine=None):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        engine=None,
+        allow_remote: bool = False,
+    ):
+        if not allow_remote and host not in ("127.0.0.1", "::1", "localhost"):
+            raise ValueError(
+                f"refusing to bind the unauthenticated bridge to {host!r}; "
+                f"pass allow_remote=True only on a trusted network"
+            )
         super().__init__((host, port), _Handler)
         self.engine = engine
 
@@ -190,11 +207,12 @@ def serve(
     port: int = 0,
     engine=None,
     background: bool = True,
+    allow_remote: bool = False,
 ) -> BridgeServer:
     """Start a bridge server; ``background=True`` runs it on a daemon
     thread and returns immediately (``server.address`` has the bound
     port)."""
-    server = BridgeServer(host, port, engine=engine)
+    server = BridgeServer(host, port, engine=engine, allow_remote=allow_remote)
     if background:
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()

@@ -136,11 +136,17 @@ def verb_span(verb: str, rows: int, blocks: int):
         return
     span = _Span(verb, {"rows": rows, "blocks": blocks})
     profile_dir = _state["profile_dir"]
-    if profile_dir:
-        import jax
+    try:
+        if profile_dir:
+            import jax
 
-        with jax.profiler.trace(profile_dir):
+            with jax.profiler.trace(profile_dir):
+                yield span
+        else:
             yield span
-    else:
-        yield span
-    span._finish()
+    except BaseException:
+        # failed verbs must still record: the span is the diagnostic
+        span.meta["failed"] = True
+        raise
+    finally:
+        span._finish()

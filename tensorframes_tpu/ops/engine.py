@@ -738,8 +738,17 @@ class Executor:
         """Pairwise combine tree over row partials: each level pairs adjacent
         same-group partials and runs ONE vmapped 2-row reduction over all
         pairs (padded to a power of two so trace count stays logarithmic).
-        Converges in ceil(log2(max_count)) levels for ANY size skew."""
-        parts = {b: data[b] for b in bases}
+        Converges in ceil(log2(max_count)) levels for ANY size skew.
+
+        Level 0 seeds every row as the partial ``f([x])`` — one vmapped
+        singleton-block dispatch over all rows — mirroring the reference
+        UDAF's init-then-merge contract (``DebugRowOps.scala:658-676``):
+        partials are always *program outputs*, never raw input rows, so
+        singleton groups get reduced too and every combine merges
+        f-partials with f (legal for the algebraic programs aggregate
+        requires)."""
+        seed = self._run_groups(vrun, {b: data[b][:, None] for b in bases})
+        parts = {b: _np(seed[b]) for b in bases}
         while len(gid) > num_groups:
             # stable-sorted gid -> segment starts -> pair adjacent elements
             seg_start = np.empty(len(gid), dtype=np.int64)

@@ -246,11 +246,22 @@ def _ring_step_kernel(
         l_out[0] = l_scr[:]
 
 
+def chunk_supported(c: int) -> bool:
+    """Whether a per-device chunk length can be Pallas-tiled on TPU."""
+    return any(c % b == 0 for b in (128, 64, 32, 16, 8))
+
+
 def _chunk_block(c: int) -> int:
     for b in (128, 64, 32, 16, 8):
         if c % b == 0:
             return b
-    return c
+    # a non-8-multiple block shape fails Mosaic tiling on real TPUs (CPU
+    # interpret mode would silently accept it — ADVICE r2); fail loudly so
+    # callers route such shapes to the xla impl instead
+    raise ValueError(
+        f"flash_ring_step needs a per-device chunk length divisible by 8 "
+        f"for TPU tiling; got C={c} — use attn impl 'xla' for this shape"
+    )
 
 
 def flash_ring_step(

@@ -92,6 +92,13 @@ def _fwd_local(q_c, k_c, v_c, *, axis, sp, causal, scale, impl="xla"):
     dtype = q_c.dtype
     ring_perm = [(i, (i + 1) % sp) for i in range(sp)]
     B, C, H, Dh = q_c.shape
+    if impl == "flash":
+        from .flash import chunk_supported
+
+        if not chunk_supported(C):
+            # Pallas blocks must tile to (8, 128) on TPU; odd chunks take
+            # the xla step instead of failing inside Mosaic (ADVICE r2)
+            impl = "xla"
     my = jax.lax.axis_index(axis)
     q_pos = my * C + jnp.arange(C)
 

@@ -32,7 +32,11 @@ from typing import Any, Callable, Optional, Tuple
 _log = logging.getLogger("tensorframes_tpu.resilience")
 
 # exception text fragments that indicate the *runtime* (not the program)
-# failed: device preemption / halt, RPC loss, collective timeouts
+# failed: device preemption / halt, RPC loss, collective timeouts.  NOTE:
+# deliberately does NOT include a bare "internal: " — XLA tags deterministic
+# compiler bugs INTERNAL too, and retrying those masks the real failure
+# (ADVICE r2); internal errors are transient only with preemption/halt/
+# collective context, which the other markers already capture.
 _TRANSIENT_MARKERS = (
     "preempt",
     "halted",
@@ -43,11 +47,40 @@ _TRANSIENT_MARKERS = (
     "collective",
     "slice has been terminated",
     "data transfer",
-    "internal: ",
 )
 
 # deterministic program errors: retrying cannot help
 _FATAL_TYPES = (TypeError, ValueError, KeyError, AttributeError)
+
+# network-loss exception types are transient regardless of message text
+_TRANSIENT_TYPES: tuple = (ConnectionError, TimeoutError)
+
+
+def _runtime_error_types() -> tuple:
+    """jax/XLA runtime-failure exception types for type-first classification.
+
+    ``JaxRuntimeError`` wraps every XLA status (UNAVAILABLE preemptions and
+    INTERNAL compiler bugs alike), so membership alone proves nothing — it
+    unlocks the status-code check below, nothing more."""
+    try:
+        from jax.errors import JaxRuntimeError
+
+        return (JaxRuntimeError,)
+    except ImportError:  # pragma: no cover - older jaxlib layout
+        try:
+            from jaxlib.xla_extension import XlaRuntimeError
+
+            return (XlaRuntimeError,)
+        except ImportError:
+            return ()
+
+
+_RUNTIME_TYPES = _runtime_error_types()
+
+# XLA runtime errors open with their absl status code; these codes mean the
+# *infrastructure* went away mid-call (vs INTERNAL / INVALID_ARGUMENT which
+# tag compiler or program bugs) and are safe to retry on that basis alone.
+_TRANSIENT_XLA_STATUS = ("unavailable", "aborted", "cancelled")
 
 
 class RestartBudgetExceeded(RuntimeError):
@@ -69,14 +102,26 @@ class FailureDetector:
         self.restarts = 0
 
     def is_transient(self, exc: BaseException) -> bool:
+        """Type-first classification (ADVICE r2): fatal program-error types
+        never retry; network-loss types always do; everything else —
+        including ``JaxRuntimeError`` — retries only when the message shows
+        runtime-failure context (preemption/halt/collective/...), so XLA
+        INTERNAL compiler bugs surface immediately instead of burning the
+        restart budget."""
         if isinstance(exc, _FATAL_TYPES):
             return False
+        if isinstance(exc, _TRANSIENT_TYPES):
+            return True
+        if _RUNTIME_TYPES and isinstance(exc, _RUNTIME_TYPES):
+            if str(exc).lower().lstrip().startswith(_TRANSIENT_XLA_STATUS):
+                return True
         text = f"{type(exc).__name__}: {exc}".lower()
         return any(m in text for m in _TRANSIENT_MARKERS)
 
     def on_failure(self, exc: BaseException) -> float:
         """Record a failure; returns the backoff to sleep, or raises."""
         if not self.is_transient(exc):
+            _log.error("non-transient failure, surfacing: %r", exc)
             raise exc
         self.restarts += 1
         if self.restarts > self.max_restarts:

@@ -113,3 +113,18 @@ def test_sessions_are_isolated(server):
         f1 = c1.create_frame({"x": np.arange(3.0)})
         with pytest.raises(BridgeError, match="unknown frame id"):
             c2.call("collect", frame_id=f1.frame_id)
+
+
+def test_non_loopback_bind_refused():
+    """ADVICE r2: the unauthenticated bridge refuses non-loopback binds
+    unless the caller explicitly trusts the network."""
+    with pytest.raises(ValueError, match="allow_remote"):
+        serve(host="0.0.0.0")
+
+
+def test_oversized_message_refused(client, monkeypatch):
+    from tensorframes_tpu.bridge import protocol
+
+    monkeypatch.setattr(protocol, "MAX_MESSAGE_BYTES", 64)
+    with pytest.raises((ValueError, ConnectionError, BridgeError)):
+        client.create_frame({"x": np.arange(1000.0)})

@@ -236,3 +236,15 @@ def test_flash_rejects_custom_positions():
         tfm.apply(params, toks, cfg, positions=pos)
     # default positions stay fine
     assert tfm.apply(params, toks, cfg).shape == (1, 8, 17)
+
+
+def test_ring_step_rejects_unaligned_chunk():
+    """ADVICE r2: a chunk length with no multiple-of-8 block must fail
+    loudly (Mosaic tiling would reject it on real TPU; interpret mode
+    would silently accept)."""
+    from tensorframes_tpu.parallel.flash import _chunk_block
+
+    assert _chunk_block(128) == 128
+    assert _chunk_block(24) == 8
+    with pytest.raises(ValueError, match="divisible by 8"):
+        _chunk_block(7)

@@ -59,6 +59,18 @@ def test_spans_recorded_for_all_verbs():
     assert rb["total_s"] >= sum(rb["phases_s"].values()) - 1e-6
 
 
+def test_failed_verb_still_records_span():
+    """ADVICE r2: a verb that raises must still record its span (tagged
+    failed) — the diagnostic matters most on the error path."""
+    observability.enable()
+    f = _frame()
+    with pytest.raises(Exception):
+        tfs.map_blocks(lambda x: {"z": x + undefined_name}, f)  # noqa: F821
+    spans = observability.last_spans()
+    assert spans and spans[-1]["verb"] == "map_blocks"
+    assert spans[-1]["failed"] is True
+
+
 def test_span_log_records(caplog):
     observability.enable()
     with caplog.at_level(logging.INFO, logger="tensorframes_tpu.verbs"):

@@ -117,6 +117,28 @@ def test_detector_classification():
     assert not d.is_transient(RuntimeError("some random failure"))
 
 
+def test_detector_classifies_real_xla_errors():
+    """ADVICE r2: classification is type-aware and a bare XLA INTERNAL
+    error (compiler bug) is NOT retried; UNAVAILABLE (preemption) is."""
+    from jax.errors import JaxRuntimeError
+
+    d = FailureDetector()
+    assert not d.is_transient(
+        JaxRuntimeError("INTERNAL: Mosaic failed to compile kernel")
+    )
+    assert d.is_transient(
+        JaxRuntimeError("UNAVAILABLE: TPU worker connection lost")
+    )
+    assert d.is_transient(JaxRuntimeError("ABORTED: coordination barrier"))
+    # preemption context still rescues an INTERNAL-tagged runtime loss
+    assert d.is_transient(
+        JaxRuntimeError("INTERNAL: slice has been terminated (maintenance)")
+    )
+    # network-loss exception types are transient regardless of text
+    assert d.is_transient(ConnectionResetError("peer vanished"))
+    assert d.is_transient(TimeoutError("barrier wait"))
+
+
 def test_backoff_grows():
     d = FailureDetector(max_restarts=3, backoff_s=1.0, backoff_factor=2.0)
     delays = [

@@ -2,6 +2,7 @@
 every verb x scalar/vector/matrix x single/multi-block, plus the naming
 contracts and error paths."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -431,6 +432,27 @@ def test_aggregate_skewed_keys_log_dispatches(monkeypatch):
     expect = np.bincount(keys[perm], weights=vals[perm])
     got = np.asarray(arrs["v"])[np.argsort(np.asarray(arrs["k"]))]
     np.testing.assert_allclose(got, expect)
+
+
+def test_aggregate_tree_applies_program_to_singletons():
+    """ADVICE r2 high: the combine tree must seed partials with f([x]) so
+    programs that are not identity on singletons (e.g. sum(|x|)) reduce
+    size-1 groups too, matching the bucketed path and UDAF semantics."""
+    sizes = [1, 3, 7, 2, 9, 4, 6, 5, 8, 10, 11, 1]  # >8 distinct -> tree
+    keys = np.concatenate([np.full(s, i) for i, s in enumerate(sizes)])
+    rng = np.random.RandomState(3)
+    vals = rng.rand(len(keys)) * 2 - 1  # negatives included
+    f = tfs.analyze(tfs.TensorFrame.from_arrays({"k": keys, "v": vals}))
+    out = tfs.aggregate(
+        lambda v_input: {"v": jnp.abs(v_input).sum(0)}, tfs.group_by(f, "k")
+    )
+    arrs = out.to_arrays()
+    order = np.argsort(np.asarray(arrs["k"]))
+    got = np.asarray(arrs["v"])[order]
+    for i in range(len(sizes)):
+        np.testing.assert_allclose(
+            got[i], np.abs(vals[keys == i]).sum(), rtol=1e-9
+        )
 
 
 def test_aggregate_skewed_vector_cells():

@@ -54,6 +54,7 @@ class OpBuilder:
         self._is_graphdef = False
         self._fetches: Optional[List[str]] = None
         self._feed: Dict[str, str] = {}
+        self._out_renames: Dict[str, str] = {}
         self._shapes: Dict[str, Sequence[int]] = {}
         self._host_stage: Dict[str, Any] = {}
 
@@ -116,6 +117,13 @@ class OpBuilder:
         self._feed.update(feed)
         return self
 
+    def outputs(self, renames: Mapping[str, str]) -> "OpBuilder":
+        """fetch ref -> result column name (GraphDef programs only): the
+        output-direction rename for frozen graphs whose node names don't
+        match the verb naming contract."""
+        self._out_renames.update(renames)
+        return self
+
     def shape(self, name: str, shape: Sequence[int]) -> "OpBuilder":
         """Output-shape hint (the ``ShapeDescription`` override mechanism,
         ``ShapeDescription.scala:3-16``)."""
@@ -148,8 +156,13 @@ class OpBuilder:
                 self._source,
                 fetches=self._fetches,
                 inputs=self._feed or None,
+                outputs=self._out_renames or None,
             )
         else:
+            if self._out_renames:
+                raise ProgramError(
+                    "outputs renames apply to GraphDef programs only"
+                )
             program = Program.wrap(
                 self._source, self._fetches, self._feed or None
             )

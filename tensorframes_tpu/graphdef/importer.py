@@ -59,12 +59,17 @@ def import_graphdef(
     graph: Union[GraphDef, bytes, str, os.PathLike],
     fetches: Sequence[str],
     inputs: Optional[Mapping[str, str]] = None,
+    outputs: Optional[Mapping[str, str]] = None,
 ) -> Program:
     """Build a Program from a frozen GraphDef.
 
     ``fetches``: output tensor names (``"out"`` or ``"out:0"``).
     ``inputs``: placeholder name -> frame column (the reference feed-dict,
     ``PythonInterface.scala:120-127``).
+    ``outputs``: fetch ref -> result column name — the output-direction
+    rename needed when a frozen graph's node names don't follow a verb's
+    naming contract (e.g. an Add node ``out`` driving ``reduce_rows`` over
+    column ``z`` must surface as output ``z``).
     """
     if not isinstance(graph, GraphDef):
         graph = load_graphdef(graph)
@@ -72,6 +77,18 @@ def import_graphdef(
     if not nodes:
         raise GraphImportError("GraphDef has no nodes")
 
+    out_map = dict(outputs or {})
+    unknown = set(out_map) - {f for f in fetches}
+    if unknown:
+        raise GraphImportError(
+            f"outputs maps unknown fetch(es) {sorted(unknown)}; "
+            f"fetches: {list(fetches)}"
+        )
+    bad = [k for k, v in out_map.items() if not v or not isinstance(v, str)]
+    if bad:
+        raise GraphImportError(
+            f"outputs renames for {sorted(bad)} must be non-empty strings"
+        )
     fetch_list: List[Tuple[str, str, int]] = []
     for f in fetches:
         name, idx = _split_ref(f)
@@ -80,10 +97,17 @@ def import_graphdef(
                 f"fetch {f!r} not found in graph; nodes: "
                 f"{sorted(nodes)[:20]}{'...' if len(nodes) > 20 else ''}"
             )
-        out_name = name if idx == 0 else f"{name}_{idx}"
+        out_name = out_map.get(f, name if idx == 0 else f"{name}_{idx}")
         fetch_list.append((out_name, name, idx))
     if not fetch_list:
         raise GraphImportError("no fetches requested")
+    dup = {n for n in (o for o, _, _ in fetch_list)
+           if sum(1 for o, _, _ in fetch_list if o == n) > 1}
+    if dup:
+        raise GraphImportError(
+            f"fetches produce colliding output name(s) {sorted(dup)}; "
+            f"disambiguate with the outputs rename map"
+        )
 
     # prune to the transitive closure of the fetches (TF session pruning —
     # placeholders outside the closure must not become required inputs)

@@ -22,6 +22,7 @@ from . import dsl, observability, resilience
 from .analyze import analyze, explain, print_schema
 from .builder import OpBuilder
 from .observability import initialize_logging
+from .data import FrameLoader
 from .dsl import block, row
 from .dtypes import ScalarType, by_name as scalar_type, supported_types
 from .frame import TensorFrame
@@ -68,6 +69,7 @@ __all__ = [
     "scalar_type",
     "supported_types",
     "TensorFrame",
+    "FrameLoader",
     "ColumnInfo",
     "Schema",
     "SchemaError",

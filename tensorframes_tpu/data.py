@@ -1,0 +1,149 @@
+"""Frame -> training-batch ingestion: the data plane feeding the training
+stack.
+
+The reference's defining property is that the DataFrame feeds every tensor
+program; its demos iterate Spark partitions into each step
+(``kmeans_demo.py:208-255``).  The TPU-native equivalent is a loader that
+turns a :class:`~.frame.TensorFrame` into a stream of device-resident,
+mesh-sharded batches:
+
+* columns are staged ONCE to host pinned buffers at construction; each
+  batch does one async ``device_put`` per column — with a mesh, a
+  *sharded* ``device_put`` so every device receives only its shard (the
+  dp-sharded input pipeline);
+* ``prefetch`` keeps N batches in flight: ``device_put`` is asynchronous,
+  so host slicing of batch k+1 overlaps device compute on batch k — the
+  host->HBM pipelining the async dispatch model gives for free;
+* per-epoch shuffling is a host-side index permutation (deterministic in
+  ``seed`` and epoch).
+
+Multi-host: build the frame with
+``parallel.multihost.frame_from_process_local`` and feed whole-frame
+steps, or run one loader per process over the process-local rows with
+``mesh`` set — each host stages only its own shard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from .frame import TensorFrame
+
+__all__ = ["FrameLoader", "lm_split"]
+
+
+@dataclasses.dataclass
+class FrameLoader:
+    """Batches a TensorFrame's columns for iterative training/eval.
+
+    ``spec``: mesh partition entries for the batch axis (default
+    ``("dp",)`` — batch sharded over dp, cells replicated).  Ignored
+    without ``mesh``.
+    """
+
+    frame: TensorFrame
+    batch_size: int
+    columns: Optional[Sequence[str]] = None
+    shuffle: bool = False
+    seed: int = 0
+    drop_remainder: bool = True
+    mesh: Optional[object] = None
+    spec: Sequence[object] = ("dp",)
+    prefetch: int = 2
+
+    def __post_init__(self):
+        names = list(self.columns or self.frame.column_names)
+        if self.batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        self._host: Dict[str, np.ndarray] = {}
+        for n in names:
+            col = self.frame.column(n)
+            if col.is_ragged:
+                raise ValueError(
+                    f"column {n!r} is not a uniform array: run "
+                    f"tfs.analyze(frame) first if the cells share a shape, "
+                    f"or pad/bucket a truly ragged column before loading"
+                )
+            if not col.info.scalar_type.device_ok:
+                raise ValueError(
+                    f"column {n!r} has host-only dtype "
+                    f"{col.info.scalar_type.name}; decode it with a map "
+                    f"verb + host_stage first"
+                )
+            # one host staging copy, reused every epoch
+            self._host[n] = np.asarray(col.data)
+        self._names = names
+        n_rows = self.frame.num_rows
+        if self.drop_remainder:
+            self._num_batches = n_rows // self.batch_size
+        else:
+            self._num_batches = -(-n_rows // self.batch_size)
+        if self._num_batches == 0:
+            raise ValueError(
+                f"frame has {n_rows} rows < batch_size {self.batch_size}"
+            )
+        self._sharding = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._sharding = NamedSharding(
+                self.mesh, PartitionSpec(*self.spec)
+            )
+
+    def __len__(self) -> int:
+        return self._num_batches
+
+    def _order(self, epoch: int) -> np.ndarray:
+        n = self.frame.num_rows
+        if not self.shuffle:
+            return np.arange(n)
+        return np.random.RandomState(
+            (self.seed * 1_000_003 + epoch) % (2**32)
+        ).permutation(n)
+
+    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, object]]:
+        """Yield one epoch of batches (dicts of device arrays)."""
+        import jax
+
+        order = self._order(epoch) if self.shuffle else None
+        pending: List[Dict[str, object]] = []
+        for b in range(self._num_batches):
+            lo, hi = b * self.batch_size, (b + 1) * self.batch_size
+            batch = {}
+            for n in self._names:
+                # unshuffled: plain slice (a view — device_put is the only
+                # copy); shuffled: one gather per batch
+                cut = (
+                    self._host[n][lo:hi]
+                    if order is None
+                    else self._host[n][order[lo:hi]]
+                )
+                batch[n] = (
+                    jax.device_put(cut, self._sharding)
+                    if self._sharding is not None
+                    else jax.device_put(cut)
+                )
+            pending.append(batch)
+            if len(pending) > max(self.prefetch, 0):
+                yield pending.pop(0)
+        yield from pending
+
+    def __iter__(self) -> Iterator[Dict[str, object]]:
+        return self.epoch(0)
+
+    def forever(self) -> Iterator[Dict[str, object]]:
+        """Epochs back to back (reshuffled each epoch when enabled)."""
+        e = 0
+        while True:
+            yield from self.epoch(e)
+            e += 1
+
+
+def lm_split(batch: Mapping[str, object], column: str = "tokens"):
+    """A [B, L+1] token batch -> (inputs [B, L], targets [B, L]) for the
+    next-token objective (``train.make_train_step`` signature)."""
+    toks = batch[column]
+    return toks[:, :-1], toks[:, 1:]

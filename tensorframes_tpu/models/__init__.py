@@ -23,6 +23,6 @@ Families here, one per BASELINE.json north-star config:
   parallelism (net-new for the TPU build, SURVEY.md §5 "long-context").
 """
 
-from . import kmeans, logistic_regression, mlp, transformer
+from . import kmeans, logistic_regression, mlp, scoring, transformer
 
-__all__ = ["kmeans", "logistic_regression", "mlp", "transformer"]
+__all__ = ["kmeans", "logistic_regression", "mlp", "scoring", "transformer"]

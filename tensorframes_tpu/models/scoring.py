@@ -1,0 +1,87 @@
+"""Transformer scoring through the verbs: the flagship model ON the data
+plane.
+
+The reference's defining contract is that the DataFrame feeds every tensor
+program — frozen conv-nets score DataFrame columns through the verbs
+(``read_image.py:108-167``, ``Operations.scala:20-135``).  This module is
+the same contract for the flagship transformer: a :class:`~.program.Program`
+whose block input is a ``tokens`` column ([n, L] int32 cells) and whose
+outputs are per-row columns (next-token NLL, perplexity, mean-pooled
+embeddings), served through ``tfs.map_blocks`` exactly like Inception.
+
+Weights are bound as a Program *param* (a pytree traced argument), so an
+iterative driver can ``program.update_params(model=new_params)`` between
+scoring passes with zero re-trace — the train-eval loop never recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..program import Program
+from . import transformer as tfm
+
+FETCHES = ("nll", "perplexity", "embedding")
+
+
+def scoring_program(
+    params: tfm.Params,
+    cfg: tfm.TransformerConfig,
+    fetches: Sequence[str] = ("nll", "perplexity"),
+    pad_id: Optional[int] = None,
+    column: str = "tokens",
+) -> Program:
+    """Program scoring token rows with a transformer LM.
+
+    Per row (a [L] int32 cell in ``column``):
+
+    * ``nll`` — mean next-token negative log-likelihood (f32 scalar);
+    * ``perplexity`` — ``exp(nll)``;
+    * ``embedding`` — mean-pooled final hidden state ([d_model] f32).
+
+    ``pad_id`` positions are excluded from the loss and the pooling mask.
+    Padding must be TAIL padding: pads are masked out of the loss and the
+    pooled embedding, but not out of attention — under the causal mask a
+    trailing pad run is never attended to by real tokens, whereas left/
+    interior pads would shift RoPE positions and leak pad embeddings into
+    real tokens' context.  The returned Program's weights update via
+    ``program.update_params(model=...)`` without re-tracing.
+    """
+    bad = sorted(set(fetches) - set(FETCHES))
+    if bad:
+        raise ValueError(f"unknown fetches {bad}; available: {FETCHES}")
+    want = list(fetches)
+    need_hidden = "embedding" in want
+
+    def fn(tokens, model):
+        toks = tokens.astype(jnp.int32)
+        res = tfm.apply(model, toks, cfg, return_hidden=need_hidden)
+        logits, hidden = res if need_hidden else (res, None)
+        targets = toks[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+        nll_tok = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        if pad_id is not None:
+            valid = (targets != pad_id).astype(jnp.float32)
+        else:
+            valid = jnp.ones_like(nll_tok)
+        denom = jnp.maximum(valid.sum(-1), 1.0)
+        nll = (nll_tok * valid).sum(-1) / denom
+        out = {"nll": nll, "perplexity": jnp.exp(nll)}
+        if need_hidden:
+            if pad_id is not None:
+                mask = (toks != pad_id).astype(jnp.float32)[..., None]
+            else:
+                mask = jnp.ones(toks.shape + (1,), jnp.float32)
+            pooled = (hidden.astype(jnp.float32) * mask).sum(1)
+            out["embedding"] = pooled / jnp.maximum(mask.sum(1), 1.0)
+        return {k: out[k] for k in want}
+
+    program = Program.wrap(
+        fn, fetches=want, params={"model": params}
+    )
+    if column != "tokens":
+        program = program.with_feed({"tokens": column})
+    return program

@@ -262,12 +262,15 @@ def apply(
     cfg: TransformerConfig,
     positions: Optional[jnp.ndarray] = None,
     blocks_runner=None,
-) -> jnp.ndarray:
+    return_hidden: bool = False,
+) -> "jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]":
     """tokens [B, L] int32 -> logits [B, L, V] (f32).
 
     ``blocks_runner(blocks, x, positions, cfg)`` overrides how the decoder
     stack runs (default sequential ``apply_blocks``; the training layer
-    passes the GPipe pipeline, ``train.pipelined_blocks``)."""
+    passes the GPipe pipeline, ``train.pipelined_blocks``).
+    ``return_hidden=True`` also returns the final-norm hidden states
+    [B, L, D] (the embedding surface for scoring programs)."""
     B, L = tokens.shape
     if positions is not None and cfg.attn_impl == "flash":
         raise ValueError(
@@ -289,7 +292,10 @@ def apply(
         params["lm_head"].astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     )
-    return shard(logits, "dp", "sp", "tp")
+    logits = shard(logits, "dp", "sp", "tp")
+    if return_hidden:
+        return logits, x
+    return logits
 
 
 def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:

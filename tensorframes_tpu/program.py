@@ -113,8 +113,11 @@ class Program:
         self._fn = fn
         self._declared_fetches = list(fetches) if fetches is not None else None
         all_names = list(input_names)
+        # a param value may be a single array OR a pytree of arrays (a model
+        # parameter tree) — both flow through jit as traced arguments
         self._params: Dict[str, Any] = {
-            k: jnp.asarray(v) for k, v in (params or {}).items()
+            k: jax.tree_util.tree_map(jnp.asarray, v)
+            for k, v in (params or {}).items()
         }
         for k in self._params:
             if k not in all_names:
@@ -297,14 +300,23 @@ class Program:
                     f"{sorted(self._params)}"
                 )
             old = self._params[k]
-            new = jnp.asarray(v)
-            if new.shape != old.shape or new.dtype != old.dtype:
+            new = jax.tree_util.tree_map(jnp.asarray, v)
+            old_leaves, old_def = jax.tree_util.tree_flatten(old)
+            new_leaves, new_def = jax.tree_util.tree_flatten(new)
+            if old_def != new_def:
                 raise ProgramError(
-                    f"update_params: {k!r} must keep shape {old.shape} / "
-                    f"dtype {old.dtype}, got {new.shape} / {new.dtype} "
-                    f"(shape changes force a re-compile; build a new "
-                    f"Program instead)"
+                    f"update_params: {k!r} must keep its pytree structure "
+                    f"(got {new_def}, expected {old_def}); structure "
+                    f"changes force a re-compile — build a new Program"
                 )
+            for ol, nl in zip(old_leaves, new_leaves):
+                if nl.shape != ol.shape or nl.dtype != ol.dtype:
+                    raise ProgramError(
+                        f"update_params: {k!r} must keep shape {ol.shape} /"
+                        f" dtype {ol.dtype}, got {nl.shape} / {nl.dtype} "
+                        f"(shape changes force a re-compile; build a new "
+                        f"Program instead)"
+                    )
             self._params[k] = new
         return self
 

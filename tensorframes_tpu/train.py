@@ -226,6 +226,53 @@ def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
     )
 
 
+def fit(
+    loader,
+    cfg: TransformerConfig,
+    tcfg: TrainConfig,
+    *,
+    steps: int,
+    params: Optional[Params] = None,
+    rng: int = 0,
+    column: str = "tokens",
+) -> Tuple[Params, Any, list]:
+    """Train the flagship LM straight from the data plane.
+
+    ``loader`` is a :class:`~.data.FrameLoader` (or any iterable of
+    ``{column: [B, L+1] int tokens}`` batches): the TensorFrame feeds the
+    train step — the reference's DataFrame-feeds-program contract
+    (``kmeans_demo.py:208-255`` iterates Spark partitions per step) applied
+    to training.  Run under ``jax.set_mesh(...)`` to shard; works unsharded
+    on one chip.
+
+    Returns ``(params, opt_state, losses)``.
+    """
+    from .data import lm_split
+
+    if params is None:
+        params = tfm.init(jax.random.PRNGKey(rng), cfg)
+    params = tfm.shard_params(params)
+    train_step, tx = make_train_step(cfg, tcfg)
+    opt_state = tx.init(params)
+    losses = []
+    it = loader.forever() if hasattr(loader, "forever") else iter(loader)
+    for step in range(steps):
+        try:
+            batch = next(it)
+        except StopIteration:
+            raise ValueError(
+                f"loader exhausted after {step} batches but steps={steps}; "
+                f"pass a FrameLoader (cycles epochs via .forever()) or an "
+                f"iterable with at least `steps` batches"
+            ) from None
+        tokens, targets = lm_split(batch, column)
+        params, opt_state, loss = train_step(
+            params, opt_state, tokens, targets
+        )
+        losses.append(loss)  # device scalars: don't sync the step loop
+    return params, opt_state, [float(l) for l in losses]
+
+
 def make_train_step(cfg: TransformerConfig, tcfg: TrainConfig):
     """Returns ``(train_step, tx)``; ``train_step(params, opt_state,
     tokens, targets) -> (params, opt_state, loss)``, jitted.  Shard params

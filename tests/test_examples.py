@@ -50,3 +50,10 @@ def test_logreg_example(capsys):
     mod["main"](n=4_000, d=16, iters=5, use_mesh=True)
     out = capsys.readouterr().out
     assert "cos(w, w_true)" in out
+
+
+def test_train_from_frame_example(capsys):
+    mod = _run("train_from_frame.py")
+    mod["main"](n_rows=16, seq=8, steps=8)
+    out = capsys.readouterr().out
+    assert "mean nll over frame" in out and "rezeroed-weights" in out

@@ -1,0 +1,228 @@
+"""Flagship <-> data-plane unification (VERDICT r2 missing #1): the
+TensorFrame feeds training, and the transformer scores through the verbs.
+
+Reference contract: the DataFrame feeds every tensor program
+(``read_image.py:108-167``, ``Operations.scala:20-135``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import train
+from tensorframes_tpu.data import FrameLoader, lm_split
+from tensorframes_tpu.models import scoring
+from tensorframes_tpu.models import transformer as tfm
+from tensorframes_tpu.parallel.mesh import training_mesh
+
+CFG = tfm.TransformerConfig(
+    vocab_size=32,
+    d_model=32,
+    n_layers=2,
+    n_heads=2,
+    n_kv_heads=2,
+    d_ff=64,
+    max_seq=16,
+)
+
+
+def token_frame(n_rows=24, seq=8, blocks=3, seed=0):
+    rng = np.random.RandomState(seed)
+    start = rng.randint(0, CFG.vocab_size, size=(n_rows, 1))
+    toks = (start + np.arange(seq + 1)) % CFG.vocab_size
+    return tfs.analyze(
+        tfs.TensorFrame.from_arrays(
+            {"tokens": toks.astype(np.int32)}, num_blocks=blocks
+        )
+    )
+
+
+# ------------------------------------------------------------ FrameLoader --
+
+
+def test_loader_batches_shapes_and_content():
+    f = token_frame(n_rows=10, seq=4)
+    loader = FrameLoader(f, batch_size=4)  # drop_remainder: 2 batches
+    batches = list(loader)
+    assert len(batches) == len(loader) == 2
+    all_rows = np.concatenate([np.asarray(b["tokens"]) for b in batches])
+    np.testing.assert_array_equal(
+        all_rows, np.asarray(f.column("tokens").data)[:8]
+    )
+
+
+def test_loader_keep_remainder():
+    f = token_frame(n_rows=10, seq=4)
+    loader = FrameLoader(f, batch_size=4, drop_remainder=False)
+    sizes = [np.asarray(b["tokens"]).shape[0] for b in loader]
+    assert sizes == [4, 4, 2]
+
+
+def test_loader_shuffle_deterministic_and_complete():
+    f = token_frame(n_rows=12, seq=4)
+    mk = lambda: FrameLoader(f, batch_size=4, shuffle=True, seed=7)
+    e0a = [np.asarray(b["tokens"]) for b in mk().epoch(0)]
+    e0b = [np.asarray(b["tokens"]) for b in mk().epoch(0)]
+    e1 = [np.asarray(b["tokens"]) for b in mk().epoch(1)]
+    for a, b in zip(e0a, e0b):
+        np.testing.assert_array_equal(a, b)  # same epoch -> same order
+    assert any((a != b).any() for a, b in zip(e0a, e1))  # reshuffled
+    # every row appears exactly once per epoch
+    ref = np.sort(np.asarray(f.column("tokens").data), axis=0)
+    np.testing.assert_array_equal(np.sort(np.concatenate(e0a), axis=0), ref)
+
+
+def test_loader_rejects_ragged_and_binary():
+    ragged = tfs.TensorFrame.from_rows(
+        [{"v": [1.0]}, {"v": [1.0, 2.0]}], num_blocks=1
+    )
+    with pytest.raises(ValueError, match="ragged"):
+        FrameLoader(ragged, batch_size=1)
+    binary = tfs.TensorFrame.from_arrays({"b": [b"x", b"y"]})
+    with pytest.raises(ValueError, match="ragged|host-only"):
+        FrameLoader(binary, batch_size=1)
+
+
+def test_loader_mesh_sharded_batches():
+    f = token_frame(n_rows=16, seq=4)
+    mesh = training_mesh(dp=8)
+    loader = FrameLoader(f, batch_size=8, mesh=mesh, spec=("dp", None))
+    batch = next(iter(loader))["tokens"]
+    assert {d.id for d in batch.sharding.device_set} == set(range(8))
+    # each device holds a [1, 5] shard of the [8, 5] batch
+    assert batch.addressable_shards[0].data.shape == (1, 5)
+
+
+def test_lm_split():
+    b = {"tokens": jnp.arange(10).reshape(2, 5)}
+    x, y = lm_split(b)
+    np.testing.assert_array_equal(np.asarray(x), [[0, 1, 2, 3], [5, 6, 7, 8]])
+    np.testing.assert_array_equal(np.asarray(y), [[1, 2, 3, 4], [6, 7, 8, 9]])
+
+
+# --------------------------------------------------------- frame -> train --
+
+
+def test_fit_from_frame_loss_decreases():
+    f = token_frame(n_rows=24, seq=8)
+    loader = FrameLoader(f, batch_size=8, shuffle=True)
+    _, _, losses = train.fit(
+        loader, CFG, train.TrainConfig(learning_rate=1e-2), steps=12
+    )
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_fit_from_frame_on_mesh():
+    """The full unification: dp-sharded loader batches into the sharded
+    train step under a live mesh."""
+    f = token_frame(n_rows=16, seq=8)
+    mesh = training_mesh(dp=2, tp=2, sp=2)
+    loader = FrameLoader(f, batch_size=8, mesh=mesh, spec=("dp", None))
+    with jax.set_mesh(mesh):
+        _, _, losses = train.fit(
+            loader, CFG, train.TrainConfig(learning_rate=1e-2), steps=6
+        )
+    assert losses[-1] < losses[0], losses
+
+
+# ------------------------------------------------- scoring via the verbs --
+
+
+def test_scoring_program_matches_direct_loss():
+    f = token_frame(n_rows=12, seq=8)
+    params = tfm.init(jax.random.PRNGKey(0), CFG)
+    scored = tfs.map_blocks(scoring.scoring_program(params, CFG), f)
+    assert {"nll", "perplexity"} <= set(scored.column_names)
+
+    toks = np.asarray(f.column("tokens").data).astype(np.int32)
+    logits = tfm.apply(params, jnp.asarray(toks), CFG)
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+    nll = -np.take_along_axis(
+        np.asarray(logp), toks[:, 1:, None], axis=-1
+    )[..., 0].mean(-1)
+    np.testing.assert_allclose(
+        np.asarray(scored.column("nll").data), nll, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(scored.column("perplexity").data), np.exp(nll), rtol=1e-5
+    )
+
+
+def test_scoring_embedding_fetch():
+    f = token_frame(n_rows=6, seq=8)
+    params = tfm.init(jax.random.PRNGKey(0), CFG)
+    p = scoring.scoring_program(params, CFG, fetches=("embedding",))
+    out = tfs.map_blocks(p, f)
+    emb = np.asarray(out.column("embedding").data)
+    assert emb.shape == (6, CFG.d_model)
+    assert np.isfinite(emb).all()
+
+
+def test_scoring_pad_id_masks_loss():
+    seq = 8
+    toks = np.full((4, seq + 1), 3, dtype=np.int32)
+    toks[:, -3:] = 0  # pad tail
+    f = tfs.analyze(tfs.TensorFrame.from_arrays({"tokens": toks}))
+    params = tfm.init(jax.random.PRNGKey(0), CFG)
+    masked = tfs.map_blocks(
+        scoring.scoring_program(params, CFG, pad_id=0), f
+    )
+    unmasked = tfs.map_blocks(scoring.scoring_program(params, CFG), f)
+    a = np.asarray(masked.column("nll").data)
+    b = np.asarray(unmasked.column("nll").data)
+    assert not np.allclose(a, b)  # pad positions excluded
+    assert np.isfinite(a).all()
+
+
+def test_scoring_column_rename():
+    f = tfs.analyze(
+        tfs.TensorFrame.from_arrays(
+            {"text_ids": token_frame(6, 8).column("tokens").data}
+        )
+    )
+    params = tfm.init(jax.random.PRNGKey(0), CFG)
+    p = scoring.scoring_program(params, CFG, column="text_ids")
+    out = tfs.map_blocks(p, f)
+    assert "nll" in out.column_names
+
+
+def test_scoring_update_params_swaps_weights():
+    f = token_frame(n_rows=6, seq=8)
+    params = tfm.init(jax.random.PRNGKey(0), CFG)
+    p = scoring.scoring_program(params, CFG)
+    before = np.asarray(tfs.map_blocks(p, f).column("nll").data)
+    p.update_params(model=jax.tree_util.tree_map(np.zeros_like, params))
+    after = np.asarray(tfs.map_blocks(p, f).column("nll").data)
+    # zero weights -> exactly uniform next-token distribution
+    np.testing.assert_allclose(
+        after, np.log(CFG.vocab_size), rtol=1e-5
+    )
+    assert not np.allclose(before, after)
+
+
+def test_update_params_rejects_structure_change():
+    params = tfm.init(jax.random.PRNGKey(0), CFG)
+    p = scoring.scoring_program(params, CFG)
+    with pytest.raises(tfs.ProgramError, match="structure"):
+        p.update_params(model={"only": jnp.zeros(3)})
+
+
+def test_trained_weights_score_better_through_verbs():
+    """The full loop: train from the frame, score the frame — trained
+    weights must beat fresh weights on the training corpus."""
+    f = token_frame(n_rows=24, seq=8)
+    loader = FrameLoader(f, batch_size=8, shuffle=True)
+    trained, _, _ = train.fit(
+        loader, CFG, train.TrainConfig(learning_rate=1e-2), steps=12
+    )
+    fresh = tfm.init(jax.random.PRNGKey(1), CFG)
+    nll_t = np.asarray(
+        tfs.map_blocks(scoring.scoring_program(trained, CFG), f)
+        .column("nll").data
+    ).mean()
+    nll_f = np.asarray(
+        tfs.map_blocks(scoring.scoring_program(fresh, CFG), f)
+        .column("nll").data
+    ).mean()
+    assert nll_t < nll_f * 0.7, (nll_t, nll_f)

@@ -113,6 +113,10 @@ class Executor:
     single-cell results of the reduce verbs.
     """
 
+    # monoid aggregates may run as one device segment reduction; mesh
+    # executors override this off (the path is single-device by design)
+    supports_segment_aggregate = True
+
     # ---------------------------------------------------------------- map --
 
     def _device_value(self, value: Any, st) -> jnp.ndarray:
@@ -618,6 +622,11 @@ class Executor:
                     f"reduced column"
                 )
 
+        # --- device-side segmented reduction (dense monoid fast path) ---
+        seg = self._aggregate_segment(program, grouped, reduced, bases, span)
+        if seg is not None:
+            return seg
+
         # --- host-side group index build (the shuffle replacement) ---
         key_cells = [np.asarray(frame.column(k).data) for k in grouped.keys]
         n = frame.num_rows
@@ -712,6 +721,92 @@ class Executor:
             cols.append(Column(info, arr))
         return TensorFrame(cols)
 
+    def _aggregate_segment(
+        self, program: Program, grouped: GroupedFrame, reduced, bases, span
+    ) -> Optional[TensorFrame]:
+        """Dense-key fast path (SURVEY P5's TPU equivalent): the whole keyed
+        reduction runs ON DEVICE as one segmented reduction.
+
+        Applies when the program is a recognized *monoid* per column —
+        ``sum`` / ``min`` / ``max`` / ``prod`` straight over the block axis
+        (detected from the jaxpr, never guessed from probing) — and the
+        single grouping key is an integer column.  Then, instead of the
+        host ``np.unique``/argsort/gather shuffle replacement:
+
+        * device stable ``argsort`` of the keys, segment ids from the
+          sorted-key boundaries, ``jax.ops.segment_{sum,min,max,prod}``
+          over the reordered column — zero full-column host copies;
+        * the one host sync is a scalar readback of the group count;
+          ``num_segments`` (static under jit) is padded to the next power
+          of two so recompiles stay logarithmic in group count;
+        * outputs (group keys + reduced cells) stay device-resident.
+
+        Returns None when not applicable (general programs keep the
+        bucketed/tree paths).  Mesh executors opt out via
+        ``supports_segment_aggregate = False`` — this path is single-device
+        by construction, and hijacking a dp-sharded aggregate onto one chip
+        would idle the mesh.  Reference: ``DebugRowOps.scala:601-695``
+        (UDAF merge), replaced here by a single XLA scatter-reduce."""
+        if not getattr(self, "supports_segment_aggregate", True):
+            return None
+        frame = grouped.frame
+        if len(grouped.keys) != 1 or frame.num_rows == 0:
+            return None
+        kcol = frame.column(grouped.keys[0])
+        kst = kcol.info.scalar_type
+        # keys must survive device canonicalisation unchanged: with x64 off,
+        # int64 keys would silently truncate to int32 on device and merge
+        # distinct groups (the hazard frame.cache() documents) — those fall
+        # back to the host np.unique path, which is exact
+        if (
+            kcol.is_ragged
+            or np.dtype(kst.np_dtype).kind not in "iub"
+            or dtypes.coerce(kst) is not kst
+        ):
+            return None
+        for b in bases:
+            col = frame.column(b)
+            if col.is_ragged or not col.info.scalar_type.device_ok:
+                return None
+        monoids = _recognize_monoids(program, reduced, bases)
+        if monoids is None:
+            return None
+
+        keys = jnp.asarray(kcol.data)
+        order = jnp.argsort(keys, stable=True)
+        sk = keys[order]
+        newseg = jnp.concatenate(
+            [jnp.ones((1,), bool), sk[1:] != sk[:-1]]
+        )
+        gid = jnp.cumsum(newseg.astype(jnp.int32)) - 1
+        num_groups = int(gid[-1]) + 1  # the one host sync (scalar)
+        pad = 1 << (num_groups - 1).bit_length()
+        uniq = sk[newseg]  # eager boolean mask: stays on device
+        span.mark("group_index_device")
+
+        outs: Dict[str, Any] = {}
+        for b in bases:
+            st = dtypes.coerce(reduced[b].scalar_type)
+            col = jnp.asarray(frame.column(b).data).astype(st.np_dtype)
+            outs[b] = _segment_reduce(
+                col[order], gid, pad, monoids[b]
+            )[:num_groups]
+        span.mark("execute")
+
+        cols: List[Column] = []
+        kinfo = ColumnInfo(
+            kcol.info.name,
+            kcol.info.scalar_type,
+            Shape(uniq.shape).with_lead(UNKNOWN),
+        )
+        cols.append(Column(kinfo, uniq))
+        for b in bases:
+            arr = outs[b]
+            st = dtypes.from_numpy(np.dtype(arr.dtype))
+            info = ColumnInfo(b, st, Shape(arr.shape).with_lead(UNKNOWN))
+            cols.append(Column(info, arr))
+        return TensorFrame(cols)
+
     def _aggregate_bucketed(
         self, vrun, bases, data, starts, by_size, num_groups
     ) -> Dict[str, np.ndarray]:
@@ -786,6 +881,107 @@ class Executor:
             parts = {b: v[order] for b, v in new_parts.items()}
         # gid is sorted and exactly one partial per group remains
         return {b: parts[b] for b in bases}
+
+
+# jaxpr reduce primitives -> segment-reduction kinds (the monoids whose
+# keyed reduction is a single XLA scatter-reduce)
+_MONOID_PRIMS = {
+    "reduce_sum": "sum",
+    "reduce_min": "min",
+    "reduce_max": "max",
+    "reduce_prod": "prod",
+}
+
+
+def _recognize_monoids(
+    program: Program, reduced, bases
+) -> Optional[Dict[str, str]]:
+    """Map each aggregate output to its monoid, or None.
+
+    Recognition reads the program's *jaxpr* (probe trace on 2-row blocks):
+    every output must be produced by exactly one ``reduce_{sum,min,max,
+    prod}`` over axis 0 applied DIRECTLY to its own ``<base>_input``
+    argument.  Anything else — scaling before the reduce, cross-column
+    arithmetic, custom folds — returns None and takes the general paths.
+    The result is memoized on the Program per input signature (one probe
+    trace ever, shared by repeated aggregate calls)."""
+    specs = {
+        f"{b}_input": jax.ShapeDtypeStruct(
+            (2,) + tuple(reduced[b].cell_shape),
+            dtypes.coerce(reduced[b].scalar_type).np_dtype,
+        )
+        for b in bases
+    }
+    key = (
+        "monoids",
+        tuple(sorted((n, s.shape, str(s.dtype)) for n, s in specs.items())),
+    )
+    cache = program._derived
+    if key in cache:
+        return cache[key]
+    cache[key] = result = _recognize_monoids_uncached(program, specs, bases)
+    return result
+
+
+def _recognize_monoids_uncached(
+    program: Program, specs, bases
+) -> Optional[Dict[str, str]]:
+    try:
+        closed, out_shape = jax.make_jaxpr(
+            lambda kw: program.call(kw), return_shape=True
+        )(specs)
+    except Exception:
+        return None
+    # program outputs must be exactly the reduced columns (the aggregate
+    # contract the general path enforces via check_reduce_blocks_outputs)
+    out_names = sorted(out_shape)
+    if out_names != sorted(bases):
+        return None
+    jaxpr = closed.jaxpr
+    # dict pytrees flatten in sorted-key order on both sides
+    in_by_var = {
+        v: name for v, name in zip(jaxpr.invars, sorted(specs))
+    }
+    producer = {}
+    for eqn in jaxpr.eqns:
+        for ov in eqn.outvars:
+            producer[ov] = eqn
+    if len(jaxpr.outvars) != len(out_names):
+        return None
+    monoids: Dict[str, str] = {}
+    for name, ov in zip(out_names, jaxpr.outvars):
+        eqn = producer.get(ov)
+        if eqn is None:
+            return None
+        kind = _MONOID_PRIMS.get(eqn.primitive.name)
+        if kind is None or tuple(eqn.params.get("axes", ())) != (0,):
+            return None
+        src = in_by_var.get(eqn.invars[0])
+        if src != f"{name}_input":
+            return None
+        monoids[name] = kind
+    return monoids
+
+
+_SEGRED_JIT: Dict[str, Any] = {}
+
+
+def _segment_reduce(data, gid, num_segments: int, kind: str):
+    """One jitted XLA segment reduction; static ``num_segments`` (padded to
+    a power of two by the caller) so executables cache per (shape, padded
+    segment count, kind)."""
+    fn = _SEGRED_JIT.get(kind)
+    if fn is None:
+        fn = _SEGRED_JIT[kind] = jax.jit(
+            {
+                "sum": jax.ops.segment_sum,
+                "min": jax.ops.segment_min,
+                "max": jax.ops.segment_max,
+                "prod": jax.ops.segment_prod,
+            }[kind],
+            static_argnames=("num_segments",),
+        )
+    return fn(data, gid, num_segments=num_segments)
 
 
 _DEFAULT = Executor()

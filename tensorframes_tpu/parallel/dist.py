@@ -54,6 +54,10 @@ _log = logging.getLogger("tensorframes_tpu.parallel")
 class MeshExecutor(Executor):
     """Distributed verb executor over a ``jax.sharding.Mesh``."""
 
+    # the single-device segment fast path would hijack a dp-sharded
+    # aggregate onto one chip; keep the groups-axis-sharded general path
+    supports_segment_aggregate = False
+
     def __init__(
         self,
         mesh: Optional[Mesh] = None,

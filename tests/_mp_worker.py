@@ -1,0 +1,112 @@
+"""Worker for the 2-process CPU integration test (run by
+test_multiprocess.py, one subprocess per simulated host).
+
+Each process brings up the jax process group via
+``multihost.initialize``, contributes ITS OWN rows to a globally
+dp-sharded TensorFrame (``frame_from_process_local``), then runs a
+cross-process ``reduce_blocks`` and one sharded transformer train step.
+Process 0 writes the results as JSON for the parent to compare against
+a single-process reference run."""
+
+import json
+import os
+import sys
+
+if __name__ == "__main__":
+    # script mode only: the parent test process imports this module for the
+    # shared cfg/data helpers and must keep ITS device-count env intact
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import tensorframes_tpu as tfs  # noqa: E402
+from tensorframes_tpu import train  # noqa: E402
+from tensorframes_tpu.data import lm_split  # noqa: E402
+from tensorframes_tpu.models import transformer as tfm  # noqa: E402
+from tensorframes_tpu.parallel import multihost  # noqa: E402
+from tensorframes_tpu.parallel.dist import MeshExecutor  # noqa: E402
+from tensorframes_tpu.parallel.mesh import training_mesh  # noqa: E402
+
+
+def make_cfg():
+    """One definition shared by the workers and the in-process parity
+    reference in test_multiprocess.py — edits stay in sync by construction."""
+    return tfm.TransformerConfig(
+        vocab_size=32, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq=16,
+    )
+
+
+def make_data():
+    """Deterministic (x, tokens) rows; both processes draw identically."""
+    rng = np.random.RandomState(0)
+    all_x = rng.rand(16).astype(np.float32)
+    toks = (
+        rng.randint(0, 32, size=(16, 1)) + np.arange(9)
+    ).astype(np.int32) % 32
+    return all_x, toks
+
+
+def main(coordinator: str, pid: int, out_path: str) -> None:
+    multihost.initialize(coordinator, num_processes=2, process_id=pid)
+    assert multihost.process_count() == 2
+    assert multihost.process_index() == pid
+    mesh = training_mesh(dp=8)  # 8 global devices: 4 local per process
+
+    # ---- globally sharded frame from process-local rows ----
+    all_x, toks = make_data()
+    local = all_x[pid * 8 : (pid + 1) * 8]  # each host holds its slice
+    frame = multihost.frame_from_process_local(
+        {"x": local}, mesh=mesh, axis="dp"
+    )
+    assert frame.num_rows == 16
+
+    # ---- cross-process reduce_blocks (ICI/DCN allreduce) ----
+    eng = MeshExecutor(mesh)
+    row = eng.reduce_blocks(
+        tfs.Program.wrap(
+            lambda x_input: {"x": x_input.sum(0)}, fetches=["x"]
+        ),
+        frame,
+    )
+    total = float(row["x"])
+
+    # ---- one sharded train step on frame-fed tokens ----
+    cfg = make_cfg()
+    tok_frame = multihost.frame_from_process_local(
+        {"tokens": toks[pid * 8 : (pid + 1) * 8]}, mesh=mesh, axis="dp"
+    )
+    with jax.set_mesh(mesh):
+        params = tfm.shard_params(tfm.init(jax.random.PRNGKey(0), cfg))
+        step, tx = train.make_train_step(cfg, train.TrainConfig())
+        opt_state = tx.init(params)
+        tokens, targets = lm_split(
+            {"tokens": tok_frame.column("tokens").data}
+        )
+        _, _, loss = step(params, opt_state, tokens, targets)
+        loss = float(loss)
+
+    if pid == 0:
+        with open(out_path, "w") as f:
+            json.dump(
+                {
+                    "process_count": multihost.process_count(),
+                    "global_devices": jax.device_count(),
+                    "local_devices": jax.local_device_count(),
+                    "reduce_sum": total,
+                    "train_loss": loss,
+                },
+                f,
+            )
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), sys.argv[3])

@@ -51,9 +51,13 @@ class TransformerConfig:
     rope_theta: float = 10_000.0
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     param_dtype: Any = jnp.float32
-    # "full" | "flash" (Pallas, sp=1) | "ring" (sp-distributed) |
-    # "ring_flash" (ring with the Pallas local step)
+    # "auto" (length-dispatched full/flash) | "full" | "flash" (Pallas,
+    # sp=1) | "ring" (sp-distributed) | "ring_flash" (ring with the Pallas
+    # local step)
     attn_impl: str = "full"
+    # "auto" picks flash at L >= this (the measured v5e crossover vs the
+    # fused XLA path, docs/PERF.md); full below it or with custom positions
+    flash_min_len: int = 8192
     remat: bool = False  # rematerialise blocks (jax.checkpoint)
 
     def __post_init__(self):
@@ -272,6 +276,15 @@ def apply(
     ``return_hidden=True`` also returns the final-norm hidden states
     [B, L, D] (the embedding surface for scoring programs)."""
     B, L = tokens.shape
+    if cfg.attn_impl == "auto":
+        # length-dispatched kernel choice (VERDICT r2 weak #2): below the
+        # crossover the fused XLA path wins; at long L flash's O(L) HBM
+        # traffic does.  Custom positions force the XLA path (flash masks
+        # with row-major arange).
+        use_flash = positions is None and L >= cfg.flash_min_len
+        cfg = dataclasses.replace(
+            cfg, attn_impl="flash" if use_flash else "full"
+        )
     if positions is not None and cfg.attn_impl == "flash":
         raise ValueError(
             "attn_impl='flash' masks with row-major arange positions and "

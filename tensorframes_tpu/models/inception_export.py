@@ -54,10 +54,14 @@ def _conv_bn_relu(g: GraphBuilder, name: _Namer, x: str, p, stride, padding):
         strides=[1, int(stride), int(stride), 1],
         padding=padding.encode(),
     )
-    scale = g.const(name("scale"), np.asarray(p["scale"], np.float32))
-    shift = g.const(name("shift"), np.asarray(p["shift"], np.float32))
-    scaled = g.op("Mul", name("bn_mul"), [conv, scale])
-    shifted = g.op("Add", name("bn_add"), [scaled, shift])
+    if "scale" in p:  # unfolded inference BN -> Mul/Add pair
+        scale = g.const(name("scale"), np.asarray(p["scale"], np.float32))
+        shift = g.const(name("shift"), np.asarray(p["shift"], np.float32))
+        scaled = g.op("Mul", name("bn_mul"), [conv, scale])
+        shifted = g.op("Add", name("bn_add"), [scaled, shift])
+    else:  # BN folded into the weights (fold_bn) -> bias only
+        bias = g.const(name("bias"), np.asarray(p["b"], np.float32))
+        shifted = g.op("BiasAdd", name("bias_add"), [conv, bias])
     return g.op("Relu", name("relu"), [shifted])
 
 

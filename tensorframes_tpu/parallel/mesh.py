@@ -9,9 +9,11 @@ model/training layer shares (``tensorframes_tpu.models`` / ``train``):
 * ``sp``  — sequence/context parallelism (ring attention, model layer);
 * ``pp``  — pipeline stages (model layer).
 
-On a single slice all axes ride ICI; across slices the outermost axis maps to
-DCN (jax device order puts slice-local devices adjacent, so inner axes stay on
-ICI — the layout recipe from the scaling book).
+On a single slice all axes ride ICI; across slices ``training_mesh(...,
+slices=S, dcn_axis=...)`` builds the grid so exactly ONE chosen axis
+crosses the DCN boundary and every other axis stays on ICI (jax device
+order puts slice-local devices adjacent — the layout recipe from the
+scaling book).
 """
 
 from __future__ import annotations
@@ -19,6 +21,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import numpy as np
 from jax.sharding import AxisType, Mesh
 
 
@@ -40,14 +43,31 @@ def data_mesh(num_devices: Optional[int] = None) -> Mesh:
     return jax.make_mesh((n,), ("dp",), axis_types=(AxisType.Auto,))
 
 
+_AXES = ("pp", "dp", "sp", "tp")
+
+
 def training_mesh(
-    dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1
+    dp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    pp: int = 1,
+    slices: int = 1,
+    dcn_axis: str = "dp",
 ) -> Mesh:
     """A 4-axis mesh for the training stack; total must equal device count.
 
     Axis order (outermost first) is ``pp, dp, sp, tp`` so that tensor
     parallelism — the most communication-intensive axis — maps to the
     innermost (fastest, ICI-adjacent) devices.
+
+    Multi-slice topologies (``slices > 1``): jax device order is
+    slice-major (a slice's devices are contiguous), so the grid is built
+    with ``dcn_axis``'s *slice component outermost*: only that one axis
+    ever crosses the DCN boundary, and every other axis — and the
+    intra-slice remainder of ``dcn_axis`` itself — stays on ICI.  This is
+    the scaling-book layout recipe: put the least chatty axis (usually
+    ``dp``, gradient allreduce once a step) across slices.  Size of
+    ``dcn_axis`` must be a multiple of ``slices``.
     """
     n = pp * dp * sp * tp
     if n != device_count():
@@ -55,8 +75,48 @@ def training_mesh(
             f"mesh size pp*dp*sp*tp = {n} != available devices "
             f"{device_count()}"
         )
-    return jax.make_mesh(
-        (pp, dp, sp, tp),
-        ("pp", "dp", "sp", "tp"),
-        axis_types=(AxisType.Auto,) * 4,
-    )
+    sizes = dict(zip(_AXES, (pp, dp, sp, tp)))
+    if slices <= 1:
+        return jax.make_mesh(
+            (pp, dp, sp, tp),
+            _AXES,
+            axis_types=(AxisType.Auto,) * 4,
+        )
+    if dcn_axis not in sizes:
+        raise ValueError(f"dcn_axis must be one of {_AXES}, got {dcn_axis!r}")
+    if sizes[dcn_axis] % slices:
+        raise ValueError(
+            f"{dcn_axis}={sizes[dcn_axis]} must be a multiple of "
+            f"slices={slices}: the DCN-crossing axis splits as "
+            f"(slices, {dcn_axis}/slices)"
+        )
+
+    # per-slice grid: the dcn_axis keeps only its intra-slice extent
+    local = dict(sizes)
+    local[dcn_axis] //= slices
+    try:
+        # real multi-slice hardware: jax's hybrid-mesh helper reads the
+        # devices' slice topology and keeps intra-slice axes ICI-adjacent
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_hybrid_device_mesh(
+            tuple(local[a] for a in _AXES),
+            tuple(slices if a == dcn_axis else 1 for a in _AXES),
+            devices=jax.devices(),
+        )
+    except Exception:
+        # virtual/CPU devices carry no slice metadata: fall back to the
+        # enumeration-order layout (slice-local devices are contiguous).
+        # Move the slice dim to sit just OUTSIDE dcn_axis's local dim, then
+        # merge: dcn index = slice * local + intra -> contiguous runs of
+        # the axis stay in-slice; crossing a run boundary is the DCN hop.
+        devs = np.asarray(jax.devices()).reshape(
+            (slices,) + tuple(local[a] for a in _AXES)
+        )
+        axis_pos = 1 + _AXES.index(dcn_axis)
+        order = list(range(1, len(_AXES) + 1))
+        order.insert(axis_pos - 1, 0)
+        grid = devs.transpose(order).reshape(
+            tuple(sizes[a] for a in _AXES)
+        )
+    return Mesh(grid, _AXES, axis_types=(AxisType.Auto,) * 4)

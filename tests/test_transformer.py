@@ -185,6 +185,32 @@ def test_pipeline_gradients_match(setup):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_pipeline_custom_positions_travel_with_microbatch(setup):
+    """Per-row positions must ride the pp ring WITH their microbatch:
+    stage s at tick t holds microbatch t-s, so indexing pos_mb[t] would
+    hand later stages the wrong rows (r3 schedule fix)."""
+    cfg, params, toks, _ = setup
+    rng = np.random.RandomState(0)
+    # distinct positions per row so a microbatch mix-up changes the output
+    pos = jnp.asarray(
+        np.sort(rng.randint(0, cfg.max_seq, size=(8, 16)), axis=1),
+        jnp.int32,
+    )
+    ref = tfm.apply(params, toks, cfg, positions=pos)
+    mesh = make_mesh(pp=2, dp=4)
+    tcfg = train.TrainConfig(pp_stages=2, microbatches=4)
+    with jax.set_mesh(mesh):
+        out = jax.jit(
+            lambda p, t: tfm.apply(
+                p, t, cfg, positions=pos,
+                blocks_runner=train._pipeline_runner(tcfg),
+            )
+        )(params, toks)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=5e-4
+    )
+
+
 def test_pipeline_validation_errors(setup):
     cfg, params, toks, tgts = setup
     mesh = make_mesh(pp=2, dp=4)

@@ -79,6 +79,10 @@ def shard(x: jnp.ndarray, *spec) -> jnp.ndarray:
     smaller meshes (or none).  Entries may be ``None``, an axis name, or a
     tuple of axis names.
     """
+    if len(spec) > x.ndim:
+        raise ValueError(
+            f"shard: {len(spec)} spec entries for a rank-{x.ndim} array"
+        )
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or not mesh.axis_names:
         return x
@@ -91,15 +95,26 @@ def shard(x: jnp.ndarray, *spec) -> jnp.ndarray:
         if types[n] != jax.sharding.AxisType.Manual
     }
 
-    def keep(entry):
+    def keep(entry, dim):
         if entry is None:
             return None
-        if isinstance(entry, (tuple, list)):
-            kept = tuple(a for a in entry if a in names)
-            return kept if kept else None
-        return entry if entry in names else None
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        kept = []
+        split = 1
+        for a in axes:
+            # an axis also drops when the dim cannot split evenly over it
+            # (e.g. ragged sequence lengths under an sp mesh): constraints
+            # degrade to a coarser sharding instead of erroring
+            if a in names and dim % (split * mesh.shape[a]) == 0:
+                kept.append(a)
+                split *= mesh.shape[a]
+        if not kept:
+            return None
+        return tuple(kept) if isinstance(entry, (tuple, list)) else kept[0]
 
-    return jax.lax.with_sharding_constraint(x, P(*(keep(e) for e in spec)))
+    return jax.lax.with_sharding_constraint(
+        x, P(*(keep(e, d) for e, d in zip(spec, x.shape)))
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -277,19 +292,46 @@ def apply(
     [B, L, D] (the embedding surface for scoring programs)."""
     B, L = tokens.shape
     if cfg.attn_impl == "auto":
-        # length-dispatched kernel choice (VERDICT r2 weak #2): below the
-        # crossover the fused XLA path wins; at long L flash's O(L) HBM
-        # traffic does.  Custom positions force the XLA path (flash masks
-        # with row-major arange).
-        use_flash = positions is None and L >= cfg.flash_min_len
-        cfg = dataclasses.replace(
-            cfg, attn_impl="flash" if use_flash else "full"
+        # kernel choice by mesh + length (VERDICT r2 weak #2).  Under an
+        # ambient mesh with a real sp axis the sequence arrives sharded, so
+        # attention must be the ring (with the Pallas local step when the
+        # per-device chunk tiles and is long enough to win).  Unsharded:
+        # below the crossover the fused XLA path wins; at long L flash's
+        # O(L) HBM traffic does.  Custom positions force the XLA paths
+        # (the Pallas kernels mask with row-major arange).
+        mesh = jax.sharding.get_abstract_mesh()
+        sp = (
+            mesh.shape["sp"]
+            if mesh is not None and "sp" in mesh.axis_names
+            else 1
         )
-    if positions is not None and cfg.attn_impl == "flash":
+        if sp > 1:
+            from ..parallel.flash import chunk_supported
+
+            if positions is not None or L % sp:
+                # ring masking derives global offsets from chunk indices
+                # (row-major) and its shard_map needs L divisible by sp;
+                # custom positions / ragged lengths take the explicit
+                # GSPMD-sharded path — correct, if chattier
+                resolved = "full"
+            elif L >= cfg.flash_min_len and chunk_supported(L // sp):
+                resolved = "ring_flash"
+            else:
+                resolved = "ring"
+        else:
+            use_flash = positions is None and L >= cfg.flash_min_len
+            resolved = "flash" if use_flash else "full"
+        cfg = dataclasses.replace(cfg, attn_impl=resolved)
+    if positions is not None and cfg.attn_impl in (
+        "flash",
+        "ring",
+        "ring_flash",
+    ):
         raise ValueError(
-            "attn_impl='flash' masks with row-major arange positions and "
-            "cannot honour custom `positions`; pass positions=None or use "
-            "attn_impl='full'/'ring'"
+            f"attn_impl={cfg.attn_impl!r} masks with row-major positions "
+            f"derived from chunk offsets and cannot honour custom "
+            f"`positions` (tokens would attend across position resets); "
+            f"pass positions=None or use attn_impl='full'/'auto'"
         )
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))

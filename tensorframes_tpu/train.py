@@ -108,7 +108,7 @@ def pipelined_blocks(
     manual = {"pp"}
     seq_spec = None
     if (
-        cfg.attn_impl == "ring"
+        cfg.attn_impl in ("ring", "ring_flash")
         and "sp" in mesh.axis_names
         and mesh.shape["sp"] > 1
     ):
@@ -145,14 +145,23 @@ def pipelined_blocks(
             # stage s is working iff its in-flight microbatch t-s is real;
             # bubble ticks (pipeline fill/drain) skip the block compute
             # entirely instead of computing-and-discarding (VERDICT r2
-            # weak #10 — (S-1)/(M+S-1) of the naive schedule's FLOPs)
+            # weak #10 — (S-1)/(M+S-1) of the naive schedule's FLOPs).
+            # ONLY when the stage body is collective-free: `active` varies
+            # across pp stages, and a lax.cond with a non-uniform predicate
+            # must not skip the sp-ring ppermutes inside ring attention
+            # (devices would disagree on the collective schedule — wrong
+            # values, verified empirically), so sp-manual bodies compute
+            # every tick like the reference GPipe forward.
             active = jnp.logical_and(t - s >= 0, t - s < M)
-            y = jax.lax.cond(
-                active,
-                lambda x: tfm.apply_blocks(stage_blocks, x, pos, cfg),
-                lambda x: jnp.zeros_like(x),
-                inp,
-            )
+            if "sp" in manual:
+                y = tfm.apply_blocks(stage_blocks, inp, pos, cfg)
+            else:
+                y = jax.lax.cond(
+                    active,
+                    lambda x: tfm.apply_blocks(stage_blocks, x, pos, cfg),
+                    lambda x: jnp.zeros_like(x),
+                    inp,
+                )
             # last stage emits microbatch t-(S-1) when it is in range
             t_out = t - (S - 1)
             emit = jnp.logical_and(is_last, t_out >= 0)

@@ -23,6 +23,13 @@ Families here, one per BASELINE.json north-star config:
   parallelism (net-new for the TPU build, SURVEY.md §5 "long-context").
 """
 
-from . import kmeans, logistic_regression, mlp, scoring, transformer
+from . import decode, kmeans, logistic_regression, mlp, scoring, transformer
 
-__all__ = ["kmeans", "logistic_regression", "mlp", "scoring", "transformer"]
+__all__ = [
+    "decode",
+    "kmeans",
+    "logistic_regression",
+    "mlp",
+    "scoring",
+    "transformer",
+]

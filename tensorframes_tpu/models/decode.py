@@ -1,0 +1,137 @@
+"""Incremental decoding: KV-cache inference + autoregressive generation.
+
+The reference scores frozen graphs but has no autoregressive story; a
+complete flagship-model family needs one.  TPU-shaped design:
+
+* the KV cache is a fixed-size ring-free buffer ([n_layers, B, S, kvh, Dh])
+  written with ``dynamic_update_slice`` — static shapes, so prefill and
+  every decode step reuse ONE compiled executable each;
+* the decode loop is a ``lax.scan`` (single trace for any number of new
+  tokens); sampling is ``jax.random.categorical`` (temperature) or argmax
+  (greedy);
+* cache slots past the written frontier are hidden by the causal mask
+  itself (their positions exceed every query position) — no validity mask;
+* GQA caches the kv heads un-repeated (kvh, not h): the repeat happens at
+  attention time, so cache memory scales with ``n_kv_heads``.
+
+Decoding is a single-chip (or dp/tp-sharded) path: queries are one token
+deep, so sequence parallelism does not apply.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import transformer as tfm
+
+Cache = Dict[str, jnp.ndarray]
+
+
+def init_cache(
+    cfg: tfm.TransformerConfig,
+    batch: int,
+    max_len: int,
+    dtype=None,
+) -> Cache:
+    """An empty KV cache holding up to ``max_len`` positions."""
+    kvh, dh, n = cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
+    dtype = dtype or cfg.dtype
+    shape = (n, batch, max_len, kvh, dh)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "index": jnp.zeros((), jnp.int32),
+    }
+
+
+def apply_cached(
+    params: tfm.Params,
+    tokens: jnp.ndarray,
+    cache: Cache,
+    cfg: tfm.TransformerConfig,
+) -> Tuple[jnp.ndarray, Cache]:
+    """Run a token chunk against the cache.
+
+    ``tokens`` [B, L] continue the sequence at ``cache['index']`` (prefill
+    passes the whole prompt; decode passes one token).  Returns
+    ``(logits [B, L, V] f32, advanced cache)``.
+
+    The caller sizes the cache: total tokens written must stay within
+    ``max_len`` (``dynamic_update_slice`` would silently clamp an
+    overflowing write).  The chunk-vs-capacity case is checked statically
+    here; ``generate`` sizes its cache exactly."""
+    B, L = tokens.shape
+    if L > cache["k"].shape[2]:
+        raise ValueError(
+            f"token chunk of {L} exceeds cache capacity "
+            f"{cache['k'].shape[2]}; build a larger init_cache"
+        )
+    idx = cache["index"]
+    positions = jnp.broadcast_to(
+        idx + jnp.arange(L, dtype=jnp.int32), (B, L)
+    )
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def step(x, layer):
+        bp, ck, cv = layer
+        x, (ck, cv) = tfm._block(bp, x, positions, cfg, kv=(ck, cv, idx))
+        return x, (ck, cv)
+
+    x, (cks, cvs) = jax.lax.scan(
+        step, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = tfm._rms_norm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "bld,dv->blv",
+        x,
+        params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, {"k": cks, "v": cvs, "index": idx + L}
+
+
+def generate(
+    params: tfm.Params,
+    prompt: jnp.ndarray,
+    cfg: tfm.TransformerConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Autoregressive continuation: prompt [B, Lp] -> [B, Lp + new].
+
+    ``temperature == 0`` decodes greedily; otherwise samples
+    ``softmax(logits / temperature)``.  Jit-friendly end to end (one
+    prefill trace + one scanned decode-step trace)."""
+    B, Lp = prompt.shape
+    if max_new_tokens <= 0:
+        return prompt
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B, Lp + max_new_tokens)
+
+    def sample(logits_last, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits_last, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            key, logits_last / jnp.float32(temperature), axis=-1
+        ).astype(prompt.dtype)
+
+    keys = jax.random.split(rng, max_new_tokens)
+    logits, cache = apply_cached(params, prompt, cache, cfg)  # prefill
+    tok = sample(logits[:, -1], keys[0])
+
+    def step(carry, key):
+        cache, tok = carry
+        logits, cache = apply_cached(params, tok[:, None], cache, cfg)
+        nxt = sample(logits[:, -1], key)
+        return (cache, nxt), tok
+
+    (cache, last), toks = jax.lax.scan(step, (cache, tok), keys[1:])
+    new = jnp.concatenate(
+        [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
+    )
+    return jnp.concatenate([prompt, new], axis=1)

@@ -211,10 +211,21 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float):
 
 
 def _block(
-    bp: Params, x: jnp.ndarray, positions: jnp.ndarray, cfg: TransformerConfig
-) -> jnp.ndarray:
+    bp: Params,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    cfg: TransformerConfig,
+    kv=None,
+):
     """One decoder block.  x: [B, L, D] (L may be the sp-local chunk when
-    ring attention is on — positions carry the global offsets)."""
+    ring attention is on — positions carry the global offsets).
+
+    ``kv``: optional ``(cache_k, cache_v, index)`` for incremental
+    decoding — caches are [B, S, kvh, Dh]; this chunk's (post-RoPE,
+    pre-GQA-repeat) k/v are written at ``index`` and attention runs over
+    the whole cache (slots past the written frontier carry positions
+    later than every query, so the causal mask hides them — no extra
+    validity mask needed).  Returns ``(x', (ck, cv))`` when caching."""
     B, L, D = x.shape
     h, kvh, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
@@ -227,12 +238,17 @@ def _block(
     q = shard(_rope(q, positions, cfg.rope_theta), "dp", "sp", "tp", None)
     k = shard(_rope(k, positions, cfg.rope_theta), "dp", "sp", "tp", None)
     v = shard(v, "dp", "sp", "tp", None)
-    if kvh != h:
-        k = jnp.repeat(k, h // kvh, axis=2)
-        v = jnp.repeat(v, h // kvh, axis=2)
     from ..parallel.ring import full_attention, ring_attention
 
-    if cfg.attn_impl in ("ring", "ring_flash"):
+    if kv is not None:
+        ck, cv, idx = kv
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), idx, 1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), idx, 1)
+        att = _cache_attention(q, ck.astype(dt), cv.astype(dt), positions)
+    elif cfg.attn_impl in ("ring", "ring_flash"):
+        if kvh != h:
+            k = jnp.repeat(k, h // kvh, axis=2)
+            v = jnp.repeat(v, h // kvh, axis=2)
         att = ring_attention(
             q, k, v, causal=True,
             impl="flash" if cfg.attn_impl == "ring_flash" else "xla",
@@ -242,8 +258,14 @@ def _block(
         # positions — the sp == 1 operating point (parallel/flash.py)
         from ..parallel.flash import flash_attention
 
+        if kvh != h:
+            k = jnp.repeat(k, h // kvh, axis=2)
+            v = jnp.repeat(v, h // kvh, axis=2)
         att = flash_attention(q, k, v, True)
     else:
+        if kvh != h:
+            k = jnp.repeat(k, h // kvh, axis=2)
+            v = jnp.repeat(v, h // kvh, axis=2)
         att = full_attention(q, k, v, True, positions, positions)
     att = att.reshape(B, L, h * dh)
     x = x + shard(att @ bp["wo"].astype(dt), "dp", "sp", None)
@@ -254,7 +276,35 @@ def _block(
     up = y @ bp["w_up"].astype(dt)
     ff = shard(gate * up, "dp", "sp", "tp")
     x = x + shard(ff @ bp["w_down"].astype(dt), "dp", "sp", None)
+    if kv is not None:
+        return x, (ck, cv)
     return x
+
+
+def _cache_attention(q, ck, cv, positions_q):
+    """Attention over a KV cache with GROUPED kv heads: q [B, L, h, Dh],
+    ck/cv [B, S, kvh, Dh].  The h/kvh query groups index the shared kv
+    head directly — the cache is never materialised h-wide (decode reads
+    scale with n_kv_heads, the point of GQA).  Numerics mirror
+    ``full_attention`` (f32 softmax, f32-accumulated matmuls); unwritten
+    cache slots are hidden by the causal mask (their arange positions
+    exceed every query position)."""
+    B, L, h, dh = q.shape
+    S, kvh = ck.shape[1], ck.shape[2]
+    g = h // kvh
+    scale = np.float32(1.0 / np.sqrt(dh))
+    qg = q.reshape(B, L, kvh, g, dh)
+    s = jnp.einsum(
+        "blkgd,bskd->bkgls", qg, ck, preferred_element_type=jnp.float32
+    ) * scale
+    k_pos = jnp.arange(S, dtype=jnp.int32)
+    mask = positions_q[:, None, None, :, None] >= k_pos[None, None, None, None, :]
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    att = jnp.einsum(
+        "bkgls,bskd->blkgd", p, cv, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+    return att.reshape(B, L, h, dh)
 
 
 def apply_blocks(

@@ -77,7 +77,9 @@ def apply_cached(
 
     def step(x, layer):
         bp, ck, cv = layer
-        x, (ck, cv) = tfm._block(bp, x, positions, cfg, kv=(ck, cv, idx))
+        # aux (MoE load-balance loss) is a training quantity — scoring
+        # and decode drop it
+        x, (ck, cv), _aux = tfm._block(bp, x, positions, cfg, kv=(ck, cv, idx))
         return x, (ck, cv)
 
     x, (cks, cvs) = jax.lax.scan(

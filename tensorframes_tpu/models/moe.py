@@ -1,0 +1,185 @@
+"""Mixture-of-experts FFN with expert parallelism (the ``ep`` mesh axis).
+
+Net-new relative to the reference (which has no models in-repo — SURVEY.md
+§2.7: its only parallelism is Spark partition data-parallelism).  A complete
+modern flagship-model family needs sparse scaling, and its TPU-native shape
+is the GShard/Switch design rather than any ragged/dynamic dispatch:
+
+* **Static-shape capacity routing.**  Every group of ``S`` tokens owns a
+  fixed per-expert buffer of ``C = ceil(S * top_k * capacity_factor / E)``
+  slots; tokens beyond an expert's capacity are dropped (their combine
+  weight is zero, so the residual stream passes them through unchanged).
+  Dispatch and combine are dense one-hot tensors ``[G, S, E, C]`` consumed
+  by einsums — everything is a matmul on the MXU, no sorts, no ragged
+  shapes, one compiled executable for every step.
+
+* **Expert parallelism as a sharding constraint.**  Expert weights carry
+  ``P("ep", ...)`` on their expert axis and the dispatched activations
+  ``[E, G, C, D]`` are constrained to the same; with groups sharded over
+  ``(dp, ep, sp)`` GSPMD lowers the layout change into the classic
+  all-to-all over the ``ep`` axis.  No hand-written collectives — the same
+  code runs unsharded on one chip.
+
+* **tp composes inside each expert**: gate/up projections are
+  column-sharded over ``tp`` and the down projection row-sharded, exactly
+  like the dense SwiGLU, so one psum per MoE layer is inserted by GSPMD.
+
+* **Groups are (batch x sp-chunk).**  Routing positions come from a cumsum
+  over the group's token axis; making each sequence-parallel chunk its own
+  group keeps that cumsum device-local under an ``sp`` mesh.
+
+The auxiliary load-balance loss is the Switch formulation
+``E * sum_e f_e * P_e`` (``f_e`` = fraction of tokens whose top-1 choice is
+expert ``e``, ``P_e`` = mean router probability), returned as an f32 scalar
+per layer and summed by the caller (``transformer.apply_blocks``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def capacity(
+    group_size: int, top_k: int, n_experts: int, factor: float
+) -> int:
+    """Per-expert slot count for one routing group — static at trace time.
+
+    Never below 1, never above ``group_size`` (a token occupies at most one
+    slot per expert across all ranks: rank ``r+1`` re-routes over the
+    experts rank ``<= r`` did not pick)."""
+    c = math.ceil(group_size * top_k * factor / n_experts)
+    return max(1, min(group_size, c))
+
+
+def gate(
+    probs: jnp.ndarray, top_k: int, cap: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-k capacity gating.
+
+    ``probs`` [G, S, E] f32 (softmaxed router output) ->
+    ``(dispatch [G, S, E, C], combine [G, S, E, C], aux [])``, all f32.
+
+    Slot assignment is rank-major then token-major (all rank-0 choices
+    claim slots before any rank-1 choice, each in token order) — the
+    GShard priority rule, so earlier ranks never lose capacity to later
+    ones.  Combine weights follow the two standard routers: top-1 uses
+    the raw gate probability (Switch — the router must receive task-loss
+    gradient through the gate, which a renormalised p/p == 1 constant
+    would kill); top-k>1 renormalises over the k picks *before* capacity
+    dropping (GShard/Mixtral).  A dropped pick contributes zero, leaving
+    the token's residual partially (or fully) un-updated rather than
+    re-scaled.
+    """
+    G, S, E = probs.shape
+    picks = []  # (onehot [G,S,E], prob [G,S]) per rank
+    masked = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)
+        oh = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+        picks.append((oh, jnp.sum(masked * oh, axis=-1)))
+        # exclude the pick with a negative sentinel, not *0: a saturated
+        # f32 softmax can underflow every other expert to exactly 0.0,
+        # and argmax over an all-zero row would re-pick expert 0,
+        # burning one of its capacity slots on a zero-weight duplicate
+        masked = jnp.where(oh > 0, jnp.float32(-1.0), masked)
+    if top_k == 1:
+        denom = jnp.ones_like(picks[0][1])
+    else:
+        denom = jnp.maximum(sum(p for _, p in picks), 1e-9)
+
+    dispatch = jnp.zeros((G, S, E, cap), probs.dtype)
+    combine = jnp.zeros((G, S, E, cap), probs.dtype)
+    used = jnp.zeros((G, 1, E), probs.dtype)  # slots taken by earlier ranks
+    for oh, p in picks:
+        # position of each token within its chosen expert's buffer:
+        # earlier tokens of this rank + everything earlier ranks used
+        pos = jnp.cumsum(oh, axis=1) - oh + used
+        used = used + jnp.sum(oh, axis=1, keepdims=True)
+        slot = jnp.sum(pos * oh, axis=-1).astype(jnp.int32)  # [G, S]
+        keep = oh * (pos < cap)  # [G, S, E]
+        slot_oh = jax.nn.one_hot(slot, cap, dtype=probs.dtype)  # [G, S, C]
+        contrib = keep[..., None] * slot_oh[:, :, None, :]
+        dispatch = dispatch + contrib
+        combine = combine + (p / denom)[..., None, None] * contrib
+
+    # Switch load-balance loss on the PRE-capacity assignment (drops are a
+    # capacity artefact; the router should be pushed toward balance, not
+    # toward whatever the drops left behind)
+    f = jnp.mean(picks[0][0], axis=(0, 1))  # top-1 fraction per expert
+    p_mean = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(f * p_mean)
+    return dispatch, combine, aux
+
+
+def _sp_groups(L: int) -> int:
+    """How many sp chunks the sequence axis splits into under the ambient
+    mesh (1 when no mesh / no divisible non-Manual ``sp`` axis)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or "sp" not in mesh.axis_names:
+        return 1
+    types = dict(zip(mesh.axis_names, mesh.axis_types))
+    if types["sp"] == jax.sharding.AxisType.Manual:
+        return 1  # inside a shard_map: L is already the local chunk
+    sp = mesh.shape["sp"]
+    return sp if sp > 1 and L % sp == 0 else 1
+
+
+def moe_mlp(bp, y: jnp.ndarray, cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The MoE replacement for the dense SwiGLU block.
+
+    ``y`` [B, L, D] (post-RMSNorm activations) -> ``(out [B, L, D],
+    aux [])``.  ``bp`` holds ``router`` [D, E], ``we_gate``/``we_up``
+    [E, D, F], ``we_down`` [E, F, D].
+    """
+    from .transformer import shard
+
+    B, L, D = y.shape
+    E = bp["router"].shape[-1]
+    dt = cfg.dtype
+    sp = _sp_groups(L)
+    G, S = B * sp, L // sp
+    yg = y.reshape(G, S, D)
+
+    logits = jnp.einsum(
+        "gsd,de->gse",
+        yg.astype(jnp.float32),
+        bp["router"].astype(jnp.float32),
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    cap = capacity(S, cfg.moe_top_k, E, cfg.moe_capacity_factor)
+    dispatch, combine, aux = gate(probs, cfg.moe_top_k, cap)
+
+    # groups -> per-expert buffers: the E axis picks up the ep sharding the
+    # G axis loses — GSPMD's cue for the dispatch all-to-all
+    ex_in = jnp.einsum(
+        "gsec,gsd->egcd", dispatch.astype(dt), yg.astype(dt),
+        preferred_element_type=jnp.float32,
+    ).astype(dt)
+    ex_in = shard(ex_in, "ep", ("dp", "sp"), None, None)
+
+    h_gate = jnp.einsum(
+        "egcd,edf->egcf", ex_in, bp["we_gate"].astype(dt),
+        preferred_element_type=jnp.float32,
+    ).astype(dt)
+    h_up = jnp.einsum(
+        "egcd,edf->egcf", ex_in, bp["we_up"].astype(dt),
+        preferred_element_type=jnp.float32,
+    ).astype(dt)
+    h = shard(jax.nn.silu(h_gate) * h_up, "ep", ("dp", "sp"), None, "tp")
+    ex_out = jnp.einsum(
+        "egcf,efd->egcd", h, bp["we_down"].astype(dt),
+        preferred_element_type=jnp.float32,
+    ).astype(dt)
+    ex_out = shard(ex_out, "ep", ("dp", "sp"), None, None)
+
+    # combine: back to token-major layout (the reverse all-to-all)
+    out = jnp.einsum(
+        "gsec,egcd->gsd", combine.astype(dt), ex_out,
+        preferred_element_type=jnp.float32,
+    ).astype(dt)
+    out = out.reshape(B, L, D)
+    return shard(out, ("dp", "ep"), "sp", None), aux

@@ -5,6 +5,9 @@ model/training layer shares (``tensorframes_tpu.models`` / ``train``):
 
 * ``dp``  — data parallelism (the verb engine shards blocks over this axis;
   the TPU equivalent of Spark partition parallelism, SURVEY.md §2.7 P1);
+* ``ep``  — expert parallelism (MoE expert FFNs, ``models/moe.py``; batch
+  also shards over ep outside the expert computation, so a size-1 ep axis
+  costs nothing);
 * ``tp``  — tensor parallelism (model layer);
 * ``sp``  — sequence/context parallelism (ring attention, model layer);
 * ``pp``  — pipeline stages (model layer).
@@ -43,7 +46,7 @@ def data_mesh(num_devices: Optional[int] = None) -> Mesh:
     return jax.make_mesh((n,), ("dp",), axis_types=(AxisType.Auto,))
 
 
-_AXES = ("pp", "dp", "sp", "tp")
+_AXES = ("pp", "dp", "ep", "sp", "tp")
 
 
 def training_mesh(
@@ -51,14 +54,17 @@ def training_mesh(
     tp: int = 1,
     sp: int = 1,
     pp: int = 1,
+    ep: int = 1,
     slices: int = 1,
     dcn_axis: str = "dp",
 ) -> Mesh:
-    """A 4-axis mesh for the training stack; total must equal device count.
+    """A 5-axis mesh for the training stack; total must equal device count.
 
-    Axis order (outermost first) is ``pp, dp, sp, tp`` so that tensor
+    Axis order (outermost first) is ``pp, dp, ep, sp, tp`` so that tensor
     parallelism — the most communication-intensive axis — maps to the
-    innermost (fastest, ICI-adjacent) devices.
+    innermost (fastest, ICI-adjacent) devices; ``ep`` (one all-to-all per
+    MoE layer) sits between the once-a-step ``dp`` and the per-layer
+    ``sp``/``tp`` axes.
 
     Multi-slice topologies (``slices > 1``): jax device order is
     slice-major (a slice's devices are contiguous), so the grid is built
@@ -69,18 +75,18 @@ def training_mesh(
     ``dp``, gradient allreduce once a step) across slices.  Size of
     ``dcn_axis`` must be a multiple of ``slices``.
     """
-    n = pp * dp * sp * tp
+    n = pp * dp * ep * sp * tp
     if n != device_count():
         raise ValueError(
-            f"mesh size pp*dp*sp*tp = {n} != available devices "
+            f"mesh size pp*dp*ep*sp*tp = {n} != available devices "
             f"{device_count()}"
         )
-    sizes = dict(zip(_AXES, (pp, dp, sp, tp)))
+    sizes = dict(zip(_AXES, (pp, dp, ep, sp, tp)))
     if slices <= 1:
         return jax.make_mesh(
-            (pp, dp, sp, tp),
+            (pp, dp, ep, sp, tp),
             _AXES,
-            axis_types=(AxisType.Auto,) * 4,
+            axis_types=(AxisType.Auto,) * 5,
         )
     if dcn_axis not in sizes:
         raise ValueError(f"dcn_axis must be one of {_AXES}, got {dcn_axis!r}")
@@ -119,4 +125,4 @@ def training_mesh(
         grid = devs.transpose(order).reshape(
             tuple(sizes[a] for a in _AXES)
         )
-    return Mesh(grid, _AXES, axis_types=(AxisType.Auto,) * 4)
+    return Mesh(grid, _AXES, axis_types=(AxisType.Auto,) * len(_AXES))

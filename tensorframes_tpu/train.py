@@ -1,12 +1,13 @@
-"""Training stack: sharded train step with dp/tp/sp/pp composition.
+"""Training stack: sharded train step with dp/ep/tp/sp/pp composition.
 
 Net-new relative to the reference (no training loop in-repo — SURVEY.md §5:
 model state is frozen into graphs as constants; iterative algorithms rebuild
 the graph per step).  The TPU-native design trains the flagship transformer
-with the full 4-axis mesh (``parallel.mesh.training_mesh``):
+with the full 5-axis mesh (``parallel.mesh.training_mesh``):
 
-* ``dp``/``tp``/``sp`` are sharding *constraints* inside the model
-  (``models/transformer.py``) — GSPMD inserts the all-reduces;
+* ``dp``/``ep``/``tp``/``sp`` are sharding *constraints* inside the model
+  (``models/transformer.py``, ``models/moe.py``) — GSPMD inserts the
+  all-reduces (and the MoE dispatch all-to-all over ``ep``);
 * ``pp`` is a GPipe-style schedule implemented as a partial-manual
   ``shard_map``: decoder blocks are stacked ``[n_layers, ...]`` and
   re-grouped ``[S, n_layers/S, ...]`` with the stage axis sharded
@@ -52,14 +53,18 @@ class TrainConfig:
 
 def _stage_params(blocks: Params, n_layers: int, stages: int) -> Params:
     """[n_layers, ...] stacked blocks -> [stages, layers_per_stage, ...],
-    lead axis sharded over ``pp``."""
+    lead axis sharded over ``pp`` while each param KEEPS its canonical
+    tp/ep layout (``transformer.block_spec``) — restacking must not drop
+    the in-stage sharding."""
     lps = n_layers // stages
-    regrouped = jax.tree_util.tree_map(
-        lambda a: a.reshape((stages, lps) + a.shape[1:]), blocks
-    )
-    return jax.tree_util.tree_map(
-        lambda a: shard(a, "pp", *([None] * (a.ndim - 1))), regrouped
-    )
+    return {
+        k: shard(
+            a.reshape((stages, lps) + a.shape[1:]),
+            "pp",
+            *tfm.block_spec(k, lead_dims=1),
+        )
+        for k, a in blocks.items()
+    }
 
 
 def pipelined_blocks(
@@ -70,10 +75,18 @@ def pipelined_blocks(
     stages: int,
     microbatches: int,
     mesh: Optional[jax.sharding.Mesh] = None,
-) -> jnp.ndarray:
+) -> "tuple[jnp.ndarray, jnp.ndarray]":
     """Run the stacked decoder blocks as a ``stages``-deep GPipe pipeline
     over the ``pp`` mesh axis.  x: [B, L, D]; batch is cut into
-    ``microbatches`` equal microbatches."""
+    ``microbatches`` equal microbatches.  Returns ``(x, aux)`` per the
+    blocks_runner contract — aux is the MoE load-balance loss summed over
+    stages and averaged over microbatches.  Note this is a per-microbatch
+    *estimator* of the full-batch aux: the Switch loss is nonlinear in
+    the batch (E * sum_e f_e * P_e), so mean-over-microbatches of
+    per-microbatch products differs from the product of full-batch means
+    by the cross-microbatch covariance of f and P — the standard
+    trade-off every microbatched MoE pipeline makes (gradients
+    accumulate per microbatch anyway)."""
     if mesh is None:
         mesh = jax.sharding.get_abstract_mesh()
     S, M = stages, microbatches
@@ -129,7 +142,7 @@ def pipelined_blocks(
         ring = [(i, (i + 1) % S) for i in range(S)]
 
         def step(carry, t):
-            buf, outs = carry
+            buf, outs, aux = carry
             t_in = jnp.clip(t, 0, M - 1)
             fresh = jax.lax.dynamic_index_in_dim(
                 x_mb, t_in, 0, keepdims=False
@@ -154,12 +167,15 @@ def pipelined_blocks(
             # every tick like the reference GPipe forward.
             active = jnp.logical_and(t - s >= 0, t - s < M)
             if "sp" in manual:
-                y = tfm.apply_blocks(stage_blocks, inp, pos, cfg)
+                y, a = tfm.apply_blocks(stage_blocks, inp, pos, cfg)
+                # bubble ticks compute (see above) but their aux is noise
+                # from stale buffers — mask it out
+                a = jnp.where(active, a, 0.0)
             else:
-                y = jax.lax.cond(
+                y, a = jax.lax.cond(
                     active,
                     lambda x: tfm.apply_blocks(stage_blocks, x, pos, cfg),
-                    lambda x: jnp.zeros_like(x),
+                    lambda x: (jnp.zeros_like(x), jnp.zeros((), jnp.float32)),
                     inp,
                 )
             # last stage emits microbatch t-(S-1) when it is in range
@@ -175,18 +191,28 @@ def pipelined_blocks(
             # rotate activations to the next stage (stage 0 receives the
             # last stage's discard — overwritten by `fresh` next step)
             buf = jax.lax.ppermute(y, "pp", ring)
-            return (buf, outs), None
+            return (buf, outs, aux + a), None
 
-        (buf, outs), _ = jax.lax.scan(
-            step, (buf, outs), jnp.arange(M + S - 1)
+        (buf, outs, aux), _ = jax.lax.scan(
+            step, (buf, outs, jnp.zeros((), jnp.float32)),
+            jnp.arange(M + S - 1)
         )
-        # replicate the last stage's collected outputs across the ring
+        # replicate the last stage's collected outputs across the ring;
+        # aux sums each stage's layers over pp, and each stage saw every
+        # microbatch once — /M averages the per-microbatch estimators
+        # (see docstring: NOT bit-identical to the full-batch aux)
         outs = jax.lax.psum(
             jnp.where(is_last, outs, jnp.zeros_like(outs)), "pp"
         )
-        return outs
+        aux = jax.lax.psum(aux, "pp") / M
+        if "sp" in manual:
+            # each sp device routed its own chunk-groups: mean over sp
+            # matches moe_mlp's mean-over-groups (out_specs declare aux
+            # replicated, so it must actually BE uniform)
+            aux = jax.lax.pmean(aux, "sp")
+        return outs, aux
 
-    outs = jax.shard_map(
+    outs, aux = jax.shard_map(
         pp_body,
         mesh=mesh,
         in_specs=(
@@ -194,11 +220,11 @@ def pipelined_blocks(
             P(None, None, seq_spec),
             P("pp"),
         ),
-        out_specs=P(None, None, seq_spec, None),
+        out_specs=(P(None, None, seq_spec, None), P()),
         axis_names=manual,
         check_vma=False,
     )(x_mb, pos_mb, staged)
-    return outs.reshape(B, L, D)
+    return outs.reshape(B, L, D), aux
 
 
 def _pipeline_runner(tcfg: TrainConfig):
@@ -226,8 +252,8 @@ def apply_pipelined(
 
 
 def loss_pipelined(params, tokens, targets, cfg, tcfg):
-    return tfm.cross_entropy(
-        apply_pipelined(params, tokens, cfg, tcfg), targets
+    return tfm.loss_fn(
+        params, tokens, targets, cfg, blocks_runner=_pipeline_runner(tcfg)
     )
 
 

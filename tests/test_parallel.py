@@ -313,14 +313,14 @@ def test_multislice_mesh_dp_crosses_dcn():
 
     mesh = training_mesh(dp=4, tp=2, slices=2, dcn_axis="dp")
     ids = np.vectorize(lambda d: d.id)(mesh.devices)
-    assert ids.shape == (1, 4, 1, 2)  # (pp, dp, sp, tp)
+    assert ids.shape == (1, 4, 1, 1, 2)  # (pp, dp, ep, sp, tp)
     # slice 0 = devices 0-3, slice 1 = devices 4-7 (jax order is
     # slice-major); dp runs 0-1 and 2-3 each stay within one slice
     np.testing.assert_array_equal(
-        ids[0, :, 0, :], [[0, 1], [2, 3], [4, 5], [6, 7]]
+        ids[0, :, 0, 0, :], [[0, 1], [2, 3], [4, 5], [6, 7]]
     )
     # tp pairs are always intra-slice (adjacent ids)
-    assert all(abs(int(a) - int(b)) == 1 for a, b in ids[0, :, 0, :])
+    assert all(abs(int(a) - int(b)) == 1 for a, b in ids[0, :, 0, 0, :])
 
 
 def test_multislice_mesh_pp_crosses_dcn():
@@ -328,7 +328,7 @@ def test_multislice_mesh_pp_crosses_dcn():
 
     mesh = training_mesh(pp=2, dp=2, tp=2, slices=2, dcn_axis="pp")
     ids = np.vectorize(lambda d: d.id)(mesh.devices)
-    assert ids.shape == (2, 2, 1, 2)
+    assert ids.shape == (2, 2, 1, 1, 2)
     assert set(ids[0].ravel()) == {0, 1, 2, 3}  # stage 0 == slice 0
     assert set(ids[1].ravel()) == {4, 5, 6, 7}  # stage 1 == slice 1
 

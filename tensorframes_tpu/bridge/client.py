@@ -36,19 +36,21 @@ class BridgeClient:
 
     def call(self, method: str, **params) -> Any:
         self._next_id += 1
+        bins: list = []
         write_message(
             self._wfile,
             {
                 "id": self._next_id,
                 "method": method,
-                "params": encode_value(params),
+                "params": encode_value(params, bins),
             },
+            bins,
         )
-        resp = read_message(self._rfile)
+        resp, rbins = read_message(self._rfile)
         if "error" in resp:
             err = resp["error"]
             raise BridgeError(err["type"], err["message"])
-        return decode_value(resp["result"])
+        return decode_value(resp["result"], rbins)
 
     def close(self) -> None:
         try:

@@ -132,13 +132,13 @@ class _Handler(socketserver.StreamRequestHandler):
         session = _Session(engine=self.server.engine)  # type: ignore[attr-defined]
         while True:
             try:
-                msg = read_message(self.rfile)
+                msg, rbins = read_message(self.rfile)
             except (ConnectionError, ValueError):
                 return
             mid = msg.get("id")
             try:
                 method = msg["method"]
-                params = decode_value(msg.get("params") or {})
+                params = decode_value(msg.get("params") or {}, rbins)
                 if method in (
                     "map_blocks",
                     "map_rows",
@@ -152,8 +152,11 @@ class _Handler(socketserver.StreamRequestHandler):
                     if fn is None or method.startswith("_"):
                         raise AttributeError(f"unknown method {method!r}")
                     result = fn(**params)
+                bins: list = []
                 write_message(
-                    self.wfile, {"id": mid, "result": encode_value(result)}
+                    self.wfile,
+                    {"id": mid, "result": encode_value(result, bins)},
+                    bins,
                 )
             except BrokenPipeError:
                 return

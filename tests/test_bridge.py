@@ -128,3 +128,74 @@ def test_oversized_message_refused(client, monkeypatch):
     monkeypatch.setattr(protocol, "MAX_MESSAGE_BYTES", 64)
     with pytest.raises((ValueError, ConnectionError, BridgeError)):
         client.create_frame({"x": np.arange(1000.0)})
+
+
+def test_wire_binary_attachments_no_inflation():
+    """Tensors above BINARY_THRESHOLD cross as raw length-prefixed chunks:
+    total wire size stays ~1.0x raw (vs 1.33x base64), and the framing
+    round-trips exactly (VERDICT r2 weak #8)."""
+    import io
+
+    from tensorframes_tpu.bridge import protocol
+
+    arr = np.arange(200_000, dtype=np.float32)  # 800 KB raw
+    bins: list = []
+    msg = {"id": 1, "result": protocol.encode_value({"x": arr}, bins)}
+    assert len(bins) == 1  # went out of band
+    buf = io.BytesIO()
+    protocol.write_message(buf, msg, bins)
+    wire = buf.getvalue()
+    assert len(wire) < arr.nbytes * 1.01 + 512  # no base64 inflation
+    buf.seek(0)
+    rmsg, rbins = protocol.read_message(buf)
+    out = protocol.decode_value(rmsg["result"], rbins)["x"]
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_small_values_stay_inline():
+    from tensorframes_tpu.bridge import protocol
+
+    bins: list = []
+    enc = protocol.encode_value({"x": np.arange(4.0), "b": b"tiny"}, bins)
+    assert bins == []  # under threshold: debuggable one-line JSON
+    assert "data" in enc["x"]["__tensor__"]
+
+
+def test_large_collect_round_trips_binary(client):
+    """End-to-end: a ~1.6 MB column crosses create_frame and collect via
+    the binary path bit-exactly."""
+    x = np.random.RandomState(0).randn(200_000).astype(np.float64)
+    f = client.create_frame({"x": x}, num_blocks=4)
+    cols = f.collect()
+    np.testing.assert_array_equal(cols["x"], x)
+
+
+def test_binary_attachment_cap_enforced(monkeypatch):
+    import io
+
+    from tensorframes_tpu.bridge import protocol
+
+    monkeypatch.setattr(protocol, "MAX_BINARY_BYTES", 1024)
+    arr = np.arange(10_000, dtype=np.float64)
+    bins: list = []
+    msg = {"v": protocol.encode_value(arr, bins)}
+    with pytest.raises(ValueError, match="binary payload"):
+        protocol.write_message(io.BytesIO(), msg, bins)
+    # and on the read side: a forged header past the cap is refused
+    buf = io.BytesIO()
+    monkeypatch.setattr(protocol, "MAX_BINARY_BYTES", 10**9)
+    protocol.write_message(buf, msg, bins)
+    monkeypatch.setattr(protocol, "MAX_BINARY_BYTES", 1024)
+    buf.seek(0)
+    with pytest.raises(ConnectionError, match="exceed"):
+        protocol.read_message(buf)
+
+
+def test_bad_bin_reference_is_protocol_error():
+    from tensorframes_tpu.bridge import protocol
+
+    bad = {"__tensor__": {"dtype": "float32", "shape": [2], "bin": 3}}
+    with pytest.raises(ConnectionError, match="attachment"):
+        protocol.decode_value(bad, [])
+    with pytest.raises(ConnectionError, match="attachment"):
+        protocol.decode_value({"__bytes__": {"bin": 0}}, None)

@@ -124,7 +124,6 @@ def test_gate_saturated_softmax_no_duplicate_pick():
     # each token occupies exactly one slot of expert 1 and one slot of a
     # DIFFERENT expert (argmax over {0, 2} at rank 2)
     assert d[0, :, 1, :].sum() == 4
-    assert d[0, :, 1, :].sum(axis=(0, 1)) == 4
     for t in range(4):
         experts = d[0, t].sum(-1)  # per-expert slot count for token t
         assert experts[1] == 1 and experts.sum() == 2

@@ -144,9 +144,17 @@ def read_message(sock_file) -> "tuple[dict, List[bytes]]":
             f"bridge message exceeds the {MAX_MESSAGE_BYTES}-byte cap"
         )
     msg = json.loads(line)
+    nbin = msg.get("nbin", 0)
+    # peer-supplied: a non-int (or bool) here is stream corruption and gets
+    # the same clean ConnectionError as every other malformed-stream case
+    if not isinstance(nbin, int) or isinstance(nbin, bool) or nbin < 0:
+        raise ConnectionError(
+            f"bridge message carries invalid nbin {nbin!r} — corrupt or "
+            f"version-skewed peer"
+        )
     bins: List[bytes] = []
     remaining = MAX_BINARY_BYTES
-    for _ in range(int(msg.get("nbin", 0))):
+    for _ in range(nbin):
         header = sock_file.read(8)
         if len(header) != 8:
             raise ConnectionError("bridge peer closed mid-attachment")

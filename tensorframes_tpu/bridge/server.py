@@ -105,7 +105,10 @@ class _Session:
 
     def run_row_verb(self, verb: str, frame_id: int, **params):
         out = self._builder(verb, self.frame(frame_id), params).build_row()
-        return {"row": {k: encode_value(np.asarray(v)) for k, v in out.items()}}
+        # raw ndarrays: the handler's single encode_value(result, bins)
+        # routes bulk payloads to the binary attachments — pre-encoding
+        # here would pin them to inline base64
+        return {"row": {k: np.asarray(v) for k, v in out.items()}}
 
     def collect(self, frame_id: int, columns=None):
         frame = self.frame(frame_id)
@@ -114,9 +117,9 @@ class _Session:
         for n in names:
             col = frame.column(n)
             if col.is_ragged or not col.info.scalar_type.device_ok:
-                out[n] = [encode_value(c) for c in col.cells()]
+                out[n] = list(col.cells())
             else:
-                out[n] = encode_value(np.asarray(col.data))
+                out[n] = np.asarray(col.data)
         return {"columns": out, "num_rows": frame.num_rows}
 
     def release(self, frame_id: int):

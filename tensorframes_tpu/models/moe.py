@@ -41,6 +41,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def capacity(
@@ -128,6 +129,54 @@ def _sp_groups(L: int) -> int:
     return sp if sp > 1 and L % sp == 0 else 1
 
 
+def _route(bp, y: jnp.ndarray, cfg):
+    """The routing prologue shared by the executed layer (``moe_mlp``) and
+    the diagnostics (``routing_stats``) — ONE definition so observability
+    can never silently diverge from what the model runs.
+
+    ``y`` [B, L, D] -> ``(yg [G, S, D], probs, dispatch, combine, aux,
+    cap)`` with groups = (batch x sp-chunk)."""
+    B, L, D = y.shape
+    E = bp["router"].shape[-1]
+    sp = _sp_groups(L)
+    G, S = B * sp, L // sp
+    yg = y.reshape(G, S, D)
+    logits = jnp.einsum(
+        "gsd,de->gse",
+        yg.astype(jnp.float32),
+        bp["router"].astype(jnp.float32),
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    cap = capacity(S, cfg.moe_top_k, E, cfg.moe_capacity_factor)
+    dispatch, combine, aux = gate(probs, cfg.moe_top_k, cap)
+    return yg, probs, dispatch, combine, aux, cap
+
+
+def routing_stats(bp, y: jnp.ndarray, cfg) -> dict:
+    """Routing diagnostics for one batch of activations — the MoE
+    observability surface (``observability.py`` spans time verbs; this
+    inspects *where tokens go*).  Runs the SAME ``_route`` as the layer.
+    Returns host-side floats:
+
+    * ``load``: per-expert fraction of all (token, rank) assignments;
+    * ``prob``: per-expert mean router probability;
+    * ``drop_fraction``: assignments lost to capacity;
+    * ``aux``: the load-balance loss this routing would contribute.
+    """
+    yg, probs, dispatch, _, aux, cap = _route(bp, y, cfg)
+    G, S, _ = yg.shape
+    assigned = float(jnp.sum(dispatch))
+    total = G * S * cfg.moe_top_k
+    load = jnp.sum(dispatch, axis=(0, 1, 3)) / max(assigned, 1.0)
+    return {
+        "load": np.asarray(load, dtype=np.float64),
+        "prob": np.asarray(jnp.mean(probs, axis=(0, 1)), dtype=np.float64),
+        "drop_fraction": 1.0 - assigned / total,
+        "capacity": cap,
+        "aux": float(aux),
+    }
+
+
 def moe_mlp(bp, y: jnp.ndarray, cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The MoE replacement for the dense SwiGLU block.
 
@@ -138,20 +187,8 @@ def moe_mlp(bp, y: jnp.ndarray, cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
     from .transformer import shard
 
     B, L, D = y.shape
-    E = bp["router"].shape[-1]
     dt = cfg.dtype
-    sp = _sp_groups(L)
-    G, S = B * sp, L // sp
-    yg = y.reshape(G, S, D)
-
-    logits = jnp.einsum(
-        "gsd,de->gse",
-        yg.astype(jnp.float32),
-        bp["router"].astype(jnp.float32),
-    )
-    probs = jax.nn.softmax(logits, axis=-1)
-    cap = capacity(S, cfg.moe_top_k, E, cfg.moe_capacity_factor)
-    dispatch, combine, aux = gate(probs, cfg.moe_top_k, cap)
+    yg, _probs, dispatch, combine, aux, _cap = _route(bp, y, cfg)
 
     # groups -> per-expert buffers: the E axis picks up the ep sharding the
     # G axis loses — GSPMD's cue for the dispatch all-to-all

@@ -304,9 +304,8 @@ def _block(
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), idx, 1)
         att = _cache_attention(q, ck.astype(dt), cv.astype(dt), positions)
     elif cfg.attn_impl in ("ring", "ring_flash"):
-        if kvh != h:
-            k = jnp.repeat(k, h // kvh, axis=2)
-            v = jnp.repeat(v, h // kvh, axis=2)
+        # GQA kv heads stay grouped: the ring rotates kv-width blocks
+        # (h/kvh x less ICI traffic) and widens per fold step locally
         att = ring_attention(
             q, k, v, causal=True,
             impl="flash" if cfg.attn_impl == "ring_flash" else "xla",

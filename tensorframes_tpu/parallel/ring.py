@@ -88,10 +88,19 @@ def _online_softmax_step(o, m, l, s, v, dtype):
 # ---------------------------------------------------------------------------
 
 
+def _widen(x, groups):
+    """[B, C, KVH, Dh] -> [B, C, KVH*groups, Dh]: GQA kv heads repeated to
+    query width.  K/V ride the ring at kv width (h/kvh x less ICI traffic);
+    the repeat happens per fold step, compute-local, and XLA lowers it to a
+    broadcast feeding the score einsum."""
+    return x if groups == 1 else jnp.repeat(x, groups, axis=2)
+
+
 def _fwd_local(q_c, k_c, v_c, *, axis, sp, causal, scale, impl="xla"):
     dtype = q_c.dtype
     ring_perm = [(i, (i + 1) % sp) for i in range(sp)]
     B, C, H, Dh = q_c.shape
+    g = H // k_c.shape[2]  # GQA group size (1 = standard MHA)
     if impl == "flash":
         from .flash import chunk_supported
 
@@ -112,16 +121,17 @@ def _fwd_local(q_c, k_c, v_c, *, axis, sp, causal, scale, impl="xla"):
 
         def fold(oml):
             o, m, l = oml
+            k_w, v_w = _widen(k_cur, g), _widen(v_cur, g)
             if impl == "flash":
                 # Pallas local step: the [B, H, C, C] score block stays in
                 # VMEM (flash.py::flash_ring_step) instead of hitting HBM
                 return flash_ring_step(
-                    q_c, k_cur, v_cur, o, m, l, my * C, src * C, causal
+                    q_c, k_w, v_w, o, m, l, my * C, src * C, causal
                 )
             s = _scores(
-                q_c, k_cur, scale, causal, q_pos, src * C + jnp.arange(C)
+                q_c, k_w, scale, causal, q_pos, src * C + jnp.arange(C)
             )
-            return _online_softmax_step(o, m, l, s, v_cur, dtype)
+            return _online_softmax_step(o, m, l, s, v_w, dtype)
 
         if impl == "flash":
             from .flash import flash_ring_step
@@ -148,10 +158,15 @@ def _fwd_local(q_c, k_c, v_c, *, axis, sp, causal, scale, impl="xla"):
 
 def _bwd_local(q_c, k_c, v_c, o_c, lse_c, do_c, *, axis, sp, causal, scale):
     """Second ring: dK/dV accumulators rotate WITH their K/V blocks and
-    arrive home after sp steps; dQ accumulates locally."""
+    arrive home after sp steps; dQ accumulates locally.  Under GQA the
+    accumulators stay kv-width (per-query-head grads group-sum down —
+    exactly the repeat's VJP), so backward ring traffic shrinks with
+    ``n_kv_heads`` too."""
     dtype = q_c.dtype
     ring_perm = [(i, (i + 1) % sp) for i in range(sp)]
     B, C, H, Dh = q_c.shape
+    KVH = k_c.shape[2]
+    g = H // KVH
     my = jax.lax.axis_index(axis)
     q_pos = my * C + jnp.arange(C)
     do32 = do_c.astype(jnp.float32)
@@ -159,9 +174,14 @@ def _bwd_local(q_c, k_c, v_c, o_c, lse_c, do_c, *, axis, sp, causal, scale):
     D = jnp.sum(do32 * o_c.astype(jnp.float32), axis=-1).transpose(0, 2, 1)
     lse_safe = jnp.where(jnp.isneginf(lse_c), 0.0, lse_c)
 
+    def group_sum(x):  # [B, Lk, H, Dh] -> [B, Lk, KVH, Dh]
+        if g == 1:
+            return x
+        return x.reshape(B, C, KVH, g, Dh).sum(axis=3)
+
     dq = jnp.zeros((B, C, H, Dh), jnp.float32)
-    dk = jnp.zeros((B, C, H, Dh), jnp.float32)
-    dv = jnp.zeros((B, C, H, Dh), jnp.float32)
+    dk = jnp.zeros((B, C, KVH, Dh), jnp.float32)
+    dv = jnp.zeros((B, C, KVH, Dh), jnp.float32)
 
     def step(i, carry):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
@@ -169,28 +189,29 @@ def _bwd_local(q_c, k_c, v_c, o_c, lse_c, do_c, *, axis, sp, causal, scale):
 
         def fold(grads):
             dq, dk_cur, dv_cur = grads
+            k_w, v_w = _widen(k_cur, g), _widen(v_cur, g)
             s = _scores(
-                q_c, k_cur, scale, causal, q_pos, src * C + jnp.arange(C)
+                q_c, k_w, scale, causal, q_pos, src * C + jnp.arange(C)
             )
             p = jnp.where(
                 jnp.isneginf(s), 0.0, jnp.exp(s - lse_safe[..., None])
             )  # [B, H, Lq, Lk] f32
-            dv_cur = dv_cur + jnp.einsum(
+            dv_cur = dv_cur + group_sum(jnp.einsum(
                 "bhqk,bqhd->bkhd", p, do32, preferred_element_type=jnp.float32
-            )
+            ))
             dp = jnp.einsum(
                 "bqhd,bkhd->bhqk",
                 do_c,
-                v_cur,
+                v_w,
                 preferred_element_type=jnp.float32,
             )
             ds = p * (dp - D[..., None]) * scale
             dq = dq + jnp.einsum(
-                "bhqk,bkhd->bqhd", ds, k_cur, preferred_element_type=jnp.float32
+                "bhqk,bkhd->bqhd", ds, k_w, preferred_element_type=jnp.float32
             )
-            dk_cur = dk_cur + jnp.einsum(
+            dk_cur = dk_cur + group_sum(jnp.einsum(
                 "bhqk,bqhd->bkhd", ds, q_c, preferred_element_type=jnp.float32
-            )
+            ))
             return dq, dk_cur, dv_cur
 
         if causal:
@@ -274,8 +295,12 @@ def ring_attention(
     mesh: Optional[jax.sharding.Mesh] = None,
     impl: str = "xla",
 ) -> jnp.ndarray:
-    """Exact attention over a globally [B, L, H, Dh] q/k/v, sequence-sharded
+    """Exact attention over a globally [B, L, H, Dh] q, sequence-sharded
     on ``axis``.  Returns [B, L, H, Dh] with q's dtype and sharding.
+
+    ``k``/``v`` may be GQA-grouped ([B, L, KVH, Dh] with H % KVH == 0):
+    they ride the ring at kv width — H/KVH x less ICI traffic both ways —
+    and widen per fold step, compute-local.
 
     Chunks must be contiguous (standard block sharding) and positions the
     plain ``0..L-1`` arange — RoPE or other positional transforms are the
@@ -339,4 +364,5 @@ def full_attention(q, k, v, causal, positions_q=None, positions_k=None):
 
 
 def _unsharded_attention(q, k, v, causal):
-    return full_attention(q, k, v, causal)
+    g = q.shape[2] // k.shape[2]
+    return full_attention(q, _widen(k, g), _widen(v, g), causal)

@@ -199,3 +199,20 @@ def test_bad_bin_reference_is_protocol_error():
         protocol.decode_value(bad, [])
     with pytest.raises(ConnectionError, match="attachment"):
         protocol.decode_value({"__bytes__": {"bin": 0}}, None)
+
+
+def test_server_collect_payload_goes_binary():
+    """The server-side collect result must reach the handler un-encoded so
+    its single encode_value(result, bins) routes bulk columns out of band
+    (review r3: pre-encoding pinned them to inline base64)."""
+    from tensorframes_tpu.bridge import protocol
+    from tensorframes_tpu.bridge.server import _Session
+
+    sess = _Session()
+    x = np.arange(200_000, dtype=np.float64)
+    fid = sess.create_frame({"x": x}, num_blocks=2)["frame_id"]
+    result = sess.collect(fid)
+    assert isinstance(result["columns"]["x"], np.ndarray)  # not pre-encoded
+    bins: list = []
+    protocol.encode_value(result, bins)
+    assert len(bins) == 1 and len(bins[0]) == x.nbytes

@@ -309,3 +309,79 @@ def test_training_mesh_has_ep_axis():
     # default ep=1 keeps old call sites working
     m = training_mesh(dp=8)
     assert m.shape["ep"] == 1
+
+
+def test_moe_sp_sharded_groups_parity():
+    """Under an sp mesh (GSPMD path, attn_impl=full) each sp chunk routes
+    as its own group.  With ample capacity (no drops) this matches the
+    unsharded forward; with the default factor it still runs (drops are
+    then chunk-local, a documented semantics difference)."""
+    cfg = moe_cfg(moe_capacity_factor=8.0)  # cap == group size: no drops
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 97)
+    ref = tfm.apply(params, toks, cfg)
+    mesh = training_mesh(dp=2, sp=2, tp=2)
+    with jax.set_mesh(mesh):
+        ps = jax.jit(tfm.shard_params)(params)
+        got = jax.jit(lambda p, t: tfm.apply(p, t, cfg))(ps, toks)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=2e-4
+    )
+    # default capacity also executes (semantics, not a crash)
+    cfg2 = moe_cfg()
+    with jax.set_mesh(mesh):
+        out = jax.jit(lambda p, t: tfm.apply(p, t, cfg2))(ps, toks)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_moe_bf16_forward_finite():
+    if jax.default_backend() != "tpu":
+        pytest.skip("XLA-CPU DotThunk lacks BF16xBF16=F32 (TPU-only path)")
+    cfg = moe_cfg(dtype=jnp.bfloat16, n_layers=2)
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 97)
+    logits, aux = tfm.apply(params, toks, cfg, return_aux=True)
+    assert logits.dtype == jnp.float32  # head accumulates f32
+    assert np.all(np.isfinite(np.asarray(logits)))
+    assert np.isfinite(float(aux))
+
+
+def test_moe_checkpoint_restore_other_mesh(tmp_path):
+    """MoE params (incl. ep-sharded expert weights) checkpoint on one mesh
+    and restore onto a different one — the elastic-recovery contract the
+    dense model already honours."""
+    from tensorframes_tpu.checkpoint import Checkpointer
+
+    cfg = moe_cfg(n_layers=2)
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    mesh_a = training_mesh(dp=2, ep=2, tp=2)
+    with jax.set_mesh(mesh_a):
+        ps = jax.jit(tfm.shard_params)(params)
+    ck = Checkpointer(str(tmp_path / "moe"))
+    ck.save(0, ps, wait=True)
+    mesh_b = training_mesh(dp=4, ep=1, tp=2)
+    with jax.set_mesh(mesh_b):
+        restored = ck.restore(0, target=jax.jit(tfm.shard_params)(params))
+    for k in ("router", "we_gate", "we_down"):
+        np.testing.assert_array_equal(
+            np.asarray(restored["blocks"][k]),
+            np.asarray(params["blocks"][k]),
+        )
+
+
+def test_routing_stats_diagnostics():
+    rng = np.random.RandomState(5)
+    D, E = 16, 4
+    bp = {"router": rng.randn(D, E).astype(np.float32)}
+    y = jnp.asarray(rng.randn(2, 8, D).astype(np.float32))
+    cfg = moe_cfg(moe_experts=E)
+    stats = moe.routing_stats(bp, y, cfg)
+    np.testing.assert_allclose(stats["load"].sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(stats["prob"].sum(), 1.0, rtol=1e-5)
+    assert 0.0 <= stats["drop_fraction"] < 1.0
+    assert stats["aux"] > 0 and stats["capacity"] >= 1
+    # tight capacity must report drops
+    tight = moe.routing_stats(
+        bp, y, moe_cfg(moe_experts=E, moe_capacity_factor=0.25)
+    )
+    assert tight["drop_fraction"] > 0

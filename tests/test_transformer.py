@@ -118,6 +118,99 @@ def test_ring_attention_matches_full(sp, causal):
     )
 
 
+@pytest.mark.parametrize("impl", ["xla", "flash"])
+def test_ring_attention_gqa_matches_repeated(impl):
+    """K/V ride the ring GQA-grouped (kv width); result and grads must
+    equal the explicit-repeat formulation exactly (group-sum IS the
+    repeat's VJP)."""
+    sp, B, L, H, KVH, Dh = 4, 2, 32, 8, 2, 16
+    mesh = make_mesh(dp=2, sp=sp)
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(ks[0], (B, L, H, Dh), jnp.float32)
+    k = jax.random.normal(ks[1], (B, L, KVH, Dh), jnp.float32)
+    v = jax.random.normal(ks[2], (B, L, KVH, Dh), jnp.float32)
+    krep = jnp.repeat(k, H // KVH, axis=2)
+    vrep = jnp.repeat(v, H // KVH, axis=2)
+    ref = _unsharded_attention(q, krep, vrep, True)
+    spec = P(None, "sp", None, None)
+
+    def loss(a, b, c):
+        return jnp.sum(ring_attention(a, b, c, causal=True, impl=impl) ** 2)
+
+    with jax.set_mesh(mesh):
+        qs = jax.device_put(q, NamedSharding(mesh, spec))
+        ks_ = jax.device_put(k, NamedSharding(mesh, spec))
+        vs = jax.device_put(v, NamedSharding(mesh, spec))
+        out = jax.jit(
+            lambda a, b, c: ring_attention(a, b, c, causal=True, impl=impl)
+        )(qs, ks_, vs)
+        gq, gk, gv = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(qs, ks_, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # oracle grads through the repeated formulation, summed back per group
+    rq, rk, rv = jax.grad(
+        lambda a, b, c: jnp.sum(
+            _unsharded_attention(
+                a, jnp.repeat(b, H // KVH, 2), jnp.repeat(c, H // KVH, 2), True
+            )
+            ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(rq), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), atol=1e-4)
+
+
+def test_ring_gqa_permutes_kv_width_blocks():
+    """The traffic claim itself: the compiled ring's collective-permutes
+    carry [B, C, KVH, Dh] blocks — kv width, not query-head width (h/kvh x
+    less ICI traffic)."""
+    import re
+
+    mesh = make_mesh(dp=2, sp=4)
+    B, L, H, KVH, Dh = 2, 32, 8, 2, 16
+    q = jnp.zeros((B, L, H, Dh), jnp.float32)
+    k = jnp.zeros((B, L, KVH, Dh), jnp.float32)
+    spec = P(None, "sp", None, None)
+    with jax.set_mesh(mesh):
+        qs = jax.device_put(q, NamedSharding(mesh, spec))
+        ks = jax.device_put(k, NamedSharding(mesh, spec))
+        hlo = (
+            jax.jit(lambda a, b, c: ring_attention(a, b, c, causal=True))
+            .lower(qs, ks, ks)
+            .compile()
+            .as_text()
+        )
+    lines = [
+        l for l in hlo.splitlines() if "collective-permute(" in l and "=" in l
+    ]
+    assert lines, "expected collective-permutes in the compiled ring"
+    shapes = {
+        m.group(1)
+        for l in lines
+        if (m := re.search(r"f32\[([\d,]+)\]", l))
+    }
+    C = L // 4
+    assert shapes == {f"{B},{C},{KVH},{Dh}"}, shapes  # kv width, never H
+
+
+def test_gqa_transformer_ring_matches_unsharded():
+    """Whole-model check: a GQA config under the sp ring reproduces the
+    unsharded forward (the k/v repeat moved inside the ring)."""
+    cfg = small_cfg(n_kv_heads=2)
+    params = tfm.init(jax.random.PRNGKey(3), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(4), (4, 16), 0, 97)
+    ref = tfm.apply(params, toks, cfg)
+    cfg_ring = dataclasses.replace(cfg, attn_impl="ring")
+    mesh = make_mesh(dp=2, sp=2, tp=2)
+    with jax.set_mesh(mesh):
+        ps = jax.jit(tfm.shard_params)(params)
+        out = jax.jit(lambda p, t: tfm.apply(p, t, cfg_ring))(ps, toks)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-4
+    )
+
+
 def test_ring_attention_no_mesh_falls_back():
     B, L, H, Dh = 1, 8, 2, 4
     q = jax.random.normal(jax.random.PRNGKey(0), (B, L, H, Dh))
@@ -258,6 +351,24 @@ def test_train_step_full_mesh_composition(setup):
                 first = float(loss)
         assert np.isfinite(float(loss))
         assert float(loss) < first, (first, float(loss))
+
+
+def test_pipelined_ring_gqa_loss_matches(setup):
+    """GQA kv-width chunks through the pp+sp manual body (ring inside the
+    pipeline stage): loss parity with the unsharded model."""
+    cfg, _, toks, tgts = setup
+    gqa = small_cfg(n_kv_heads=2)
+    params = tfm.init(jax.random.PRNGKey(5), gqa)
+    ref = tfm.loss_fn(params, toks, tgts, gqa)
+    gqa_ring = dataclasses.replace(gqa, attn_impl="ring")
+    tcfg = train.TrainConfig(pp_stages=2, microbatches=2)
+    mesh = make_mesh(pp=2, sp=2, tp=2)
+    with jax.set_mesh(mesh):
+        ps = jax.jit(tfm.shard_params)(params)
+        got = jax.jit(
+            lambda p, t, g: train.loss_pipelined(p, t, g, gqa_ring, tcfg)
+        )(ps, toks, tgts)
+    np.testing.assert_allclose(float(got), float(ref), rtol=5e-4)
 
 
 def test_checkpoint_roundtrip(tmp_path, setup):

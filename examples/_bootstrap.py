@@ -1,0 +1,8 @@
+"""Path shim so examples run straight from a checkout:
+``python examples/<name>.py`` puts examples/ on sys.path; importing this
+module prepends the repo root so ``tensorframes_tpu`` resolves."""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))

@@ -12,6 +12,8 @@ Run: ``python examples/geom_mean.py``
 import jax.numpy as jnp
 import numpy as np
 
+import _bootstrap  # noqa: F401  (checkout path shim; examples/ is on sys.path when run directly)
+
 import tensorframes_tpu as tfs
 
 

@@ -26,6 +26,8 @@ import time
 
 import numpy as np
 
+import _bootstrap  # noqa: F401  (checkout path shim; examples/ is on sys.path when run directly)
+
 import tensorframes_tpu as tfs
 from tensorframes_tpu.models import kmeans
 

@@ -22,6 +22,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+import _bootstrap  # noqa: F401  (checkout path shim; examples/ is on sys.path when run directly)
+
 import tensorframes_tpu as tfs
 from tensorframes_tpu.models import inception
 

@@ -19,6 +19,8 @@ Run: ``python examples/train_from_frame.py``
 import jax
 import numpy as np
 
+import _bootstrap  # noqa: F401  (checkout path shim; examples/ is on sys.path when run directly)
+
 import tensorframes_tpu as tfs
 from tensorframes_tpu import train
 from tensorframes_tpu.models import scoring

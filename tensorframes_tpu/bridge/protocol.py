@@ -109,6 +109,9 @@ def decode_value(v: Any, bins: Optional[List[bytes]] = None) -> Any:
 # really collects multi-GB frames through the bridge.
 MAX_MESSAGE_BYTES = 256 * 1024 * 1024
 MAX_BINARY_BYTES = 1024 * 1024 * 1024  # total attachments per message
+# attachment COUNT cap: per-bytes-object heap overhead (~50 B) means a
+# huge nbin of tiny chunks could exhaust memory under the byte cap alone
+MAX_BINARY_COUNT = 65_536
 
 
 def write_message(sock_file, msg: dict, bins: Optional[List[bytes]] = None) -> None:
@@ -147,10 +150,14 @@ def read_message(sock_file) -> "tuple[dict, List[bytes]]":
     nbin = msg.get("nbin", 0)
     # peer-supplied: a non-int (or bool) here is stream corruption and gets
     # the same clean ConnectionError as every other malformed-stream case
-    if not isinstance(nbin, int) or isinstance(nbin, bool) or nbin < 0:
+    if (
+        not isinstance(nbin, int)
+        or isinstance(nbin, bool)
+        or not 0 <= nbin <= MAX_BINARY_COUNT
+    ):
         raise ConnectionError(
             f"bridge message carries invalid nbin {nbin!r} — corrupt or "
-            f"version-skewed peer"
+            f"version-skewed peer (cap {MAX_BINARY_COUNT})"
         )
     bins: List[bytes] = []
     remaining = MAX_BINARY_BYTES

@@ -177,6 +177,26 @@ def routing_stats(bp, y: jnp.ndarray, cfg) -> dict:
     }
 
 
+def layer_routing_stats(params, tokens: jnp.ndarray, cfg, layer: int = 0) -> dict:
+    """``routing_stats`` on the ACTUAL MLP input of block ``layer`` for a
+    token batch: runs the forward through blocks ``0..layer-1`` and block
+    ``layer``'s attention half, then probes its router — the activations
+    are exactly what training routed, not an embedding-space proxy."""
+    from . import transformer as tfm
+
+    B, L = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    blocks = params["blocks"]
+    for i in range(layer):
+        bp_i = jax.tree_util.tree_map(lambda a: a[i], blocks)
+        x, _ = tfm._block(bp_i, x, positions, cfg)
+    bp = jax.tree_util.tree_map(lambda a: a[layer], blocks)
+    x, _ = tfm._attn_residual(bp, x, positions, cfg)
+    y = tfm._rms_norm(x, bp["ln2"])
+    return routing_stats(bp, y, cfg)
+
+
 def moe_mlp(bp, y: jnp.ndarray, cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The MoE replacement for the dense SwiGLU block.
 

@@ -284,11 +284,35 @@ def _block(
     Returns ``(x', aux)`` — ``aux`` is the block's MoE load-balance loss
     (f32 scalar, 0 for dense blocks) — or ``(x', (ck, cv), aux)`` when
     caching."""
+    x, cache = _attn_residual(bp, x, positions, cfg, kv)
+    dt = cfg.dtype
+
+    # -- MLP: dense SwiGLU or mixture of experts ----------------------------
+    y = _rms_norm(x, bp["ln2"])
+    if cfg.moe_experts:
+        from .moe import moe_mlp
+
+        ff_out, aux = moe_mlp(bp, y, cfg)
+        x = x + ff_out
+    else:
+        gate = jax.nn.silu(y @ bp["w_gate"].astype(dt))
+        up = y @ bp["w_up"].astype(dt)
+        ff = shard(gate * up, ("dp", "ep"), "sp", "tp")
+        x = x + shard(ff @ bp["w_down"].astype(dt), ("dp", "ep"), "sp", None)
+        aux = jnp.zeros((), jnp.float32)
+    if kv is not None:
+        return x, cache, aux
+    return x, aux
+
+
+def _attn_residual(bp, x, positions, cfg, kv=None):
+    """The attention half of a block: x -> x + Wo(attn(...)).  Returns
+    ``(x', cache)`` (cache None outside decode).  Split out of ``_block``
+    so diagnostics (``moe.layer_routing_stats``) can reproduce the EXACT
+    activations the MLP half routes."""
     B, L, D = x.shape
     h, kvh, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
-
-    # -- attention ----------------------------------------------------------
     y = _rms_norm(x, bp["ln1"])
     q = (y @ bp["wq"].astype(dt)).reshape(B, L, h, dh)
     k = (y @ bp["wk"].astype(dt)).reshape(B, L, kvh, dh)
@@ -326,23 +350,7 @@ def _block(
         att = full_attention(q, k, v, True, positions, positions)
     att = att.reshape(B, L, h * dh)
     x = x + shard(att @ bp["wo"].astype(dt), ("dp", "ep"), "sp", None)
-
-    # -- MLP: dense SwiGLU or mixture of experts ----------------------------
-    y = _rms_norm(x, bp["ln2"])
-    if cfg.moe_experts:
-        from .moe import moe_mlp
-
-        ff_out, aux = moe_mlp(bp, y, cfg)
-        x = x + ff_out
-    else:
-        gate = jax.nn.silu(y @ bp["w_gate"].astype(dt))
-        up = y @ bp["w_up"].astype(dt)
-        ff = shard(gate * up, ("dp", "ep"), "sp", "tp")
-        x = x + shard(ff @ bp["w_down"].astype(dt), ("dp", "ep"), "sp", None)
-        aux = jnp.zeros((), jnp.float32)
-    if kv is not None:
-        return x, (ck, cv), aux
-    return x, aux
+    return x, ((ck, cv) if kv is not None else None)
 
 
 def _cache_attention(q, ck, cv, positions_q):

@@ -185,9 +185,11 @@ def test_segment_scale_smoke():
         out = _DEFAULT.aggregate(prog, grouped)
         np.asarray(out.column("v").data)  # force readback: honest timing
         elapsed = min(elapsed, time.perf_counter() - t0)
-        if elapsed < 3.0:
+        if elapsed < 6.0:
             break
-    assert elapsed < 3.0, f"segment aggregate took {elapsed:.2f}s (best of 3)"
+    # generous cap: the claim is sub-second steady state on an idle box,
+    # but suite-parallel CI load has been observed to 5x wall time
+    assert elapsed < 6.0, f"segment aggregate took {elapsed:.2f}s (best of 3)"
     counts = np.bincount(keys, minlength=n_keys)
     present = np.unique(keys)
     np.testing.assert_allclose(

@@ -14,6 +14,10 @@ EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
 
 
 def _run(name):
+    # runpy does not add the script dir to sys.path; the examples import
+    # a shared _bootstrap shim that lives there
+    if str(EXAMPLES) not in sys.path:
+        sys.path.insert(0, str(EXAMPLES))
     return runpy.run_path(str(EXAMPLES / name), run_name="not_main")
 
 
@@ -57,3 +61,10 @@ def test_train_from_frame_example(capsys):
     mod["main"](n_rows=16, seq=8, steps=8)
     out = capsys.readouterr().out
     assert "mean nll over frame" in out and "rezeroed-weights" in out
+
+
+def test_moe_train_example(capsys):
+    mod = _run("moe_train.py")
+    mod["main"](n_rows=16, seq=8, steps=6)
+    out = capsys.readouterr().out
+    assert "expert load" in out and "4-expert top-2 MoE" in out

@@ -385,3 +385,26 @@ def test_routing_stats_diagnostics():
         bp, y, moe_cfg(moe_experts=E, moe_capacity_factor=0.25)
     )
     assert tight["drop_fraction"] > 0
+
+
+def test_layer_routing_stats_uses_real_activations():
+    """layer_routing_stats probes the block's ACTUAL MLP input (post-attn
+    RMSNorm), so it differs from an embedding-space probe and matches a
+    hand-computed replay."""
+    cfg = moe_cfg(n_layers=2)
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 97)
+    stats1 = moe.layer_routing_stats(params, toks, cfg, layer=1)
+    np.testing.assert_allclose(stats1["load"].sum(), 1.0, rtol=1e-6)
+    # hand replay: block 0 full, block 1 attention half, then routing_stats
+    positions = jnp.broadcast_to(
+        jnp.arange(16, dtype=jnp.int32), (2, 16)
+    )
+    x = params["embed"].astype(cfg.dtype)[toks]
+    bp0 = jax.tree_util.tree_map(lambda a: a[0], params["blocks"])
+    x, _ = tfm._block(bp0, x, positions, cfg)
+    bp1 = jax.tree_util.tree_map(lambda a: a[1], params["blocks"])
+    x, _ = tfm._attn_residual(bp1, x, positions, cfg)
+    expect = moe.routing_stats(bp1, tfm._rms_norm(x, bp1["ln2"]), cfg)
+    np.testing.assert_allclose(stats1["load"], expect["load"])
+    assert stats1["capacity"] == expect["capacity"]

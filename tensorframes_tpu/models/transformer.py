@@ -336,12 +336,10 @@ def _attn_residual(bp, x, positions, cfg, kv=None):
         )
     elif cfg.attn_impl == "flash":
         # Pallas online-softmax kernel (O(L) HBM traffic); row-major causal
-        # positions — the sp == 1 operating point (parallel/flash.py)
+        # positions — the sp == 1 operating point (parallel/flash.py).
+        # GQA k/v pass at kv width: the kernel's index maps share blocks
         from ..parallel.flash import flash_attention
 
-        if kvh != h:
-            k = jnp.repeat(k, h // kvh, axis=2)
-            v = jnp.repeat(v, h // kvh, axis=2)
         att = flash_attention(q, k, v, True)
     else:
         if kvh != h:

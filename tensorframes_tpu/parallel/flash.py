@@ -144,10 +144,27 @@ def _to_bh(x, L_p):
     return _pad_to(x, L_p, axis=1)
 
 
+def _kv_head_map(H: int, KVH: int):
+    """Grid row (batch*H + h) -> K/V array row (batch*KVH + h//g): GQA K/V
+    stay kv-width in HBM and every query head of a group reads the SAME
+    block — no materialised repeat, h/kvh x less K/V HBM traffic."""
+    if H % KVH:
+        # a non-divisible count would wrap the map into the NEXT batch's
+        # kv rows — silent cross-batch corruption; fail loudly instead
+        raise ValueError(
+            f"flash attention needs n_heads divisible by n_kv_heads; "
+            f"got H={H}, KVH={KVH}"
+        )
+    g = H // KVH
+    return lambda b: (b // H) * KVH + (b % H) // g
+
+
 def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
-    """Returns ``(out [B, Lq, H, Dh], lse [B*H, Lq_p, 1])``."""
+    """Returns ``(out [B, Lq, H, Dh], lse [B*H, Lq_p, 1])``.  k/v may be
+    GQA-grouped [B, Lk, KVH, Dh] with H % KVH == 0."""
     B, Lq, H, Dh = q.shape
-    Lk = k.shape[1]
+    Lk, KVH = k.shape[1], k.shape[2]
+    kv_of = _kv_head_map(H, KVH)
     scale = 1.0 / np.sqrt(Dh)
     bq, bk, Lq_p, Lk_p = _blocking(Lq, Lk, block_q, block_k)
 
@@ -169,8 +186,8 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, Dh), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (kv_of(b), j, 0)),
+            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (kv_of(b), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, Dh), lambda b, i, j: (b, i, 0)),
@@ -431,12 +448,16 @@ def _flash_bwd_dq_kernel(
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dk_ref, dv_ref,
     dk_scr, dv_scr,
-    *, scale, causal, block_q, block_k, seq_q, seq_k,
+    *, scale, causal, block_q, block_k, seq_q, seq_k, n_q_blocks,
 ):
     ki = pl.program_id(1)  # k blocks are the outer loop here
-    qi = pl.program_id(2)
+    # the inner axis enumerates (query head of the GQA group, q block):
+    # one kv head's dK/dV accumulate over ALL its query heads in VMEM,
+    # so grouped grads need no cross-block reduction
+    t = pl.program_id(2)
+    qi = t % n_q_blocks
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -464,7 +485,7 @@ def _flash_bwd_dkv_kernel(
             ds.astype(q.dtype).T, q, preferred_element_type=jnp.float32
         ) * np.float32(scale)
 
-    @pl.when(qi == pl.num_programs(2) - 1)
+    @pl.when(t == pl.num_programs(2) - 1)
     def _store():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -474,9 +495,12 @@ def _flash_bwd_impl(
     q, k, v, out, lse, g, causal, block_q, block_k, interpret
 ):
     B, Lq, H, Dh = q.shape
-    Lk = k.shape[1]
+    Lk, KVH = k.shape[1], k.shape[2]
+    grp = H // KVH
+    kv_of = _kv_head_map(H, KVH)
     scale = 1.0 / np.sqrt(Dh)
     bq, bk, Lq_p, Lk_p = _blocking(Lq, Lk, block_q, block_k)
+    nq = Lq_p // bq
 
     qb, kb, vb = _to_bh(q, Lq_p), _to_bh(k, Lk_p), _to_bh(v, Lk_p)
     dob = _to_bh(g, Lq_p)
@@ -492,7 +516,7 @@ def _flash_bwd_impl(
         seq_q=Lq, seq_k=Lk,
     )
     row_spec = pl.BlockSpec((1, bq, Dh), lambda b, i, j: (b, i, 0))
-    col_spec = pl.BlockSpec((1, bk, Dh), lambda b, i, j: (b, j, 0))
+    col_spec = pl.BlockSpec((1, bk, Dh), lambda b, i, j: (kv_of(b), j, 0))
     row1_spec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
     # dQ: q blocks outer, k blocks inner
     dq = pl.pallas_call(
@@ -506,19 +530,24 @@ def _flash_bwd_impl(
         interpret=interpret,
     )(qb, kb, vb, dob, lse, dd)
 
-    # dK/dV: k blocks outer, q blocks inner (block index roles swap)
-    row_spec2 = pl.BlockSpec((1, bq, Dh), lambda b, j, i: (b, i, 0))
-    col_spec2 = pl.BlockSpec((1, bk, Dh), lambda b, j, i: (b, j, 0))
-    row1_spec2 = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0))
+    # dK/dV: grid rows are KV heads; the inner axis runs (group head,
+    # q block) so one kv head's dK/dV accumulate over all its query heads
+    # in scratch — GQA grads come out kv-width with no extra reduction
+    def q_row(b, j, t):
+        return ((b // KVH) * H + (b % KVH) * grp + t // nq, t % nq, 0)
+
+    row_spec2 = pl.BlockSpec((1, bq, Dh), q_row)
+    col_spec2 = pl.BlockSpec((1, bk, Dh), lambda b, j, t: (b, j, 0))
+    row1_spec2 = pl.BlockSpec((1, bq, 1), q_row)
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, **kw),
-        grid=(B * H, Lk_p // bk, Lq_p // bq),
+        functools.partial(_flash_bwd_dkv_kernel, n_q_blocks=nq, **kw),
+        grid=(B * KVH, Lk_p // bk, grp * nq),
         in_specs=[row_spec2, col_spec2, col_spec2, row_spec2, row1_spec2,
                   row1_spec2],
         out_specs=[col_spec2, col_spec2],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Lk_p, Dh), k.dtype),
-            jax.ShapeDtypeStruct((B * H, Lk_p, Dh), v.dtype),
+            jax.ShapeDtypeStruct((B * KVH, Lk_p, Dh), k.dtype),
+            jax.ShapeDtypeStruct((B * KVH, Lk_p, Dh), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, Dh), jnp.float32),
@@ -527,10 +556,10 @@ def _flash_bwd_impl(
         interpret=interpret,
     )(qb, kb, vb, dob, lse, dd)
 
-    def from_bh(x, L):
-        return jnp.swapaxes(x[:, :L].reshape(B, H, L, Dh), 1, 2)
+    def from_bh(x, L, heads):
+        return jnp.swapaxes(x[:, :L].reshape(B, heads, L, Dh), 1, 2)
 
-    return from_bh(dq, Lq), from_bh(dk, Lk), from_bh(dv, Lk)
+    return from_bh(dq, Lq, H), from_bh(dk, Lk, KVH), from_bh(dv, Lk, KVH)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -545,8 +574,11 @@ def flash_attention(
 ):
     """softmax(QK^T / sqrt(d)) V with online softmax in a Pallas kernel.
 
-    q: [B, Lq, H, Dh]; k/v: [B, Lk, H, Dh] (GQA heads already repeated,
-    matching ``full_attention``'s contract).  Causal masking uses row-major
+    q: [B, Lq, H, Dh]; k/v: [B, Lk, KVH, Dh] with H % KVH == 0 — GQA
+    K/V stay kv-width in HBM: every query head of a group reads the same
+    K/V blocks via the grid index map (no materialised repeat, h/kvh x
+    less K/V HBM traffic), and dK/dV accumulate per kv head inside the
+    backward kernel, coming out kv-width.  Causal masking uses row-major
     positions (``arange``) — the sp == 1 case; use ``ring_attention`` for
     sequence-sharded inputs.
 

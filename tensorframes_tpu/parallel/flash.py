@@ -311,19 +311,23 @@ def flash_ring_step(
     (o numerator f32, m row-max, l denominator) carry travelling between
     hops instead of living in scratch.
 
-    q/k/v: [B, C, H, Dh]; o: [B, C, H, Dh] f32; m/l: [B, H, C] f32;
+    q: [B, C, H, Dh]; k/v: [B, C, KVH, Dh] (GQA kv heads stay grouped —
+    the kernel's index maps share blocks, so the ring never materialises
+    an h-wide K/V per hop); o: [B, C, H, Dh] f32; m/l: [B, H, C] f32;
     ``q_off``/``k_off``: traced int32 global positions of the chunks.
     Returns the updated (o, m, l).
     """
     B, C, H, Dh = q.shape
+    KVH = k.shape[2]
+    kv_of = _kv_head_map(H, KVH)
     scale = 1.0 / np.sqrt(Dh)
     bq = _chunk_block(C)
     bk = bq
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    def to_bh(x):  # [B, C, H, D] -> [B*H, C, D]
-        return jnp.swapaxes(x, 1, 2).reshape(B * H, C, x.shape[-1])
+    def to_bh(x):  # [B, C, h, D] -> [B*h, C, D]
+        return jnp.swapaxes(x, 1, 2).reshape(B * x.shape[2], C, x.shape[-1])
 
     qb, kb, vb, ob = to_bh(q), to_bh(k), to_bh(v), to_bh(o)
     # m/l travel as [BH, C, 1]: TPU block tiling needs the last two dims to
@@ -350,8 +354,8 @@ def flash_ring_step(
             smem,
             smem,
             pl.BlockSpec((1, bq, Dh), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (kv_of(b), j, 0)),
+            pl.BlockSpec((1, bk, Dh), lambda b, i, j: (kv_of(b), j, 0)),
             pl.BlockSpec((1, bq, Dh), lambda b, i, j: (b, i, 0)),
             carry_spec,
             carry_spec,

@@ -121,13 +121,14 @@ def _fwd_local(q_c, k_c, v_c, *, axis, sp, causal, scale, impl="xla"):
 
         def fold(oml):
             o, m, l = oml
-            k_w, v_w = _widen(k_cur, g), _widen(v_cur, g)
             if impl == "flash":
                 # Pallas local step: the [B, H, C, C] score block stays in
-                # VMEM (flash.py::flash_ring_step) instead of hitting HBM
+                # VMEM (flash.py::flash_ring_step) instead of hitting HBM;
+                # GQA k/v pass at kv width (kernel index maps share blocks)
                 return flash_ring_step(
-                    q_c, k_w, v_w, o, m, l, my * C, src * C, causal
+                    q_c, k_cur, v_cur, o, m, l, my * C, src * C, causal
                 )
+            k_w, v_w = _widen(k_cur, g), _widen(v_cur, g)
             s = _scores(
                 q_c, k_w, scale, causal, q_pos, src * C + jnp.arange(C)
             )

@@ -95,19 +95,72 @@ def apply_cached(
     return logits, {"k": cks, "v": cvs, "index": idx + L}
 
 
+def sample_logits(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jnp.ndarray:
+    """One sampling step over final-position logits [B, V] -> tokens [B].
+
+    ``temperature == 0`` is greedy argmax (top_k/top_p ignored).
+    Otherwise softmax(logits / temperature) restricted SEQUENTIALLY (the
+    standard filter-then-renormalise composition):
+
+    * ``top_k > 0``: only the k highest-probability tokens survive;
+    * ``top_p < 1``: the nucleus of the *remaining* (renormalised)
+      distribution — the smallest prefix of its probability-sorted
+      support whose cumulative mass reaches p (the first token is always
+      kept, so the support is never empty).
+
+    Static-shape TPU formulation: ``lax.top_k`` for the k filter (no full
+    sort in the decode hot loop when only top_k is set); one descending
+    sort of the already-filtered logits for the nucleus — masks, no
+    dynamic vocab slicing, one compiled step."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / jnp.float32(temperature)
+    neg_inf = jnp.float32(-jnp.inf)
+    if top_k > 0:
+        kth = jax.lax.top_k(scaled, min(top_k, scaled.shape[-1]))[0][:, -1]
+        scaled = jnp.where(scaled >= kth[:, None], scaled, neg_inf)
+    if top_p < 1.0:
+        # sorted AFTER the k filter: dropped tokens sink to the tail as
+        # -inf and carry zero mass, so the nucleus renormalises over the
+        # survivors — sequential semantics
+        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]  # descending
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep ranks whose PRECEDING mass is < p (rank 0 always kept)
+        keep_sorted = jnp.concatenate(
+            [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p],
+            axis=-1,
+        )
+        # threshold = smallest kept sorted logit; mask the original
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1
+        )
+        scaled = jnp.where(scaled >= cutoff[:, None], scaled, neg_inf)
+    return jax.random.categorical(key, scaled, axis=-1)
+
+
 def generate(
     params: tfm.Params,
     prompt: jnp.ndarray,
     cfg: tfm.TransformerConfig,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     rng: Optional[jax.Array] = None,
 ) -> jnp.ndarray:
     """Autoregressive continuation: prompt [B, Lp] -> [B, Lp + new].
 
     ``temperature == 0`` decodes greedily; otherwise samples
-    ``softmax(logits / temperature)``.  Jit-friendly end to end (one
-    prefill trace + one scanned decode-step trace)."""
+    ``softmax(logits / temperature)`` filtered by ``top_k``/``top_p``
+    (``sample_logits``).  Jit-friendly end to end (one prefill trace +
+    one scanned decode-step trace)."""
     B, Lp = prompt.shape
     if max_new_tokens <= 0:
         return prompt
@@ -116,10 +169,8 @@ def generate(
     cache = init_cache(cfg, B, Lp + max_new_tokens)
 
     def sample(logits_last, key):
-        if temperature == 0.0:
-            return jnp.argmax(logits_last, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(
-            key, logits_last / jnp.float32(temperature), axis=-1
+        return sample_logits(
+            logits_last, key, temperature, top_k, top_p
         ).astype(prompt.dtype)
 
     keys = jax.random.split(rng, max_new_tokens)

@@ -145,3 +145,103 @@ def test_chunk_larger_than_cache_rejected(params):
     toks = jax.random.randint(jax.random.PRNGKey(7), (1, 12), 0, 32)
     with pytest.raises(ValueError, match="cache capacity"):
         decode.apply_cached(params, toks, decode.init_cache(CFG, 1, 8), CFG)
+
+
+# -- sampling filters --------------------------------------------------------
+
+
+def test_sample_logits_top_k():
+    from tensorframes_tpu.models.decode import sample_logits
+
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0, 4.0]] * 64)
+    keys = jax.random.split(jax.random.PRNGKey(0), 64)
+    toks = np.asarray(
+        jax.vmap(lambda l, k: sample_logits(l[None], k, 1.0, top_k=2)[0])(
+            logits, keys
+        )
+    )
+    assert set(toks) <= {3, 4}  # only the two highest survive
+    assert len(set(toks)) == 2  # and both actually get sampled
+
+
+def test_sample_logits_top_p():
+    from tensorframes_tpu.models.decode import sample_logits
+
+    # probs ~ [0.643, 0.236, 0.087, 0.032, 0.002]: nucleus at p=0.8 is
+    # {0, 1} (0.643 < 0.8, 0.643+0.236 > 0.8 keeps rank 1, rank 2 starts
+    # past it)
+    logits = jnp.log(jnp.asarray([[0.643, 0.236, 0.087, 0.032, 0.002]] * 64))
+    keys = jax.random.split(jax.random.PRNGKey(1), 64)
+    toks = np.asarray(
+        jax.vmap(lambda l, k: sample_logits(l[None], k, 1.0, top_p=0.8)[0])(
+            logits, keys
+        )
+    )
+    assert set(toks) <= {0, 1}
+    assert len(set(toks)) == 2
+
+
+def test_sample_logits_top_p_never_empty():
+    from tensorframes_tpu.models.decode import sample_logits
+
+    # one dominant token above p: the argmax must always survive
+    logits = jnp.asarray([[10.0, 0.0, 0.0]])
+    tok = sample_logits(logits, jax.random.PRNGKey(0), 1.0, top_p=0.01)
+    assert int(tok[0]) == 0
+
+
+def test_sample_logits_greedy_ignores_filters():
+    from tensorframes_tpu.models.decode import sample_logits
+
+    logits = jnp.asarray([[0.0, 5.0, 1.0]])
+    tok = sample_logits(logits, jax.random.PRNGKey(0), 0.0, top_k=1, top_p=0.1)
+    assert int(tok[0]) == 1
+
+
+def test_generate_top_k_sampling_runs(params):
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    out = decode.generate(
+        params, prompt, CFG, 5, temperature=0.8, top_k=8, top_p=0.9,
+        rng=jax.random.PRNGKey(2),
+    )
+    assert out.shape == (1, 8)
+    assert np.all(np.asarray(out) >= 0)
+    assert np.all(np.asarray(out) < CFG.vocab_size)
+
+
+# -- sharded decode ----------------------------------------------------------
+
+
+def test_generate_tp_sharded_matches_unsharded(params):
+    """Greedy generation under a dp/tp mesh reproduces the single-device
+    continuation (decode is documented dp/tp-shardable)."""
+    from jax.sharding import AxisType
+
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, CFG.vocab_size, (4, 5)), jnp.int32
+    )
+    ref = np.asarray(decode.generate(params, prompt, CFG, 6))
+    mesh = jax.make_mesh(
+        (2, 4), ("dp", "tp"), axis_types=(AxisType.Auto,) * 2
+    )
+    with jax.set_mesh(mesh):
+        ps = jax.jit(tfm.shard_params)(params)
+        got = np.asarray(decode.generate(ps, prompt, CFG, 6))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_sample_logits_sequential_topk_then_topp():
+    """top_p composes over the RENORMALISED top-k survivors (sequential
+    semantics): probs [.35,.25,.2,.2] with k=2 renormalise to
+    [.583,.417]; at p=0.4 only the argmax survives — the full-distribution
+    nucleus would have kept both."""
+    from tensorframes_tpu.models.decode import sample_logits
+
+    logits = jnp.log(jnp.asarray([[0.35, 0.25, 0.2, 0.2]] * 64))
+    keys = jax.random.split(jax.random.PRNGKey(3), 64)
+    toks = np.asarray(
+        jax.vmap(
+            lambda l, k: sample_logits(l[None], k, 1.0, top_k=2, top_p=0.4)[0]
+        )(logits, keys)
+    )
+    assert set(toks) == {0}

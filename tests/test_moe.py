@@ -408,3 +408,20 @@ def test_layer_routing_stats_uses_real_activations():
     expect = moe.routing_stats(bp1, tfm._rms_norm(x, bp1["ln2"]), cfg)
     np.testing.assert_allclose(stats1["load"], expect["load"])
     assert stats1["capacity"] == expect["capacity"]
+
+
+def test_moe_with_ring_attention_parity():
+    """MoE MLPs composed with sp ring attention (the long-context + sparse
+    combination): parity with the unsharded forward at ample capacity."""
+    cfg = moe_cfg(moe_capacity_factor=8.0, attn_impl="full")
+    params = tfm.init(jax.random.PRNGKey(7), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(8), (4, 16), 0, 97)
+    ref = tfm.apply(params, toks, cfg)
+    ring_cfg = dataclasses.replace(cfg, attn_impl="ring")
+    mesh = training_mesh(dp=2, sp=2, tp=2)
+    with jax.set_mesh(mesh):
+        ps = jax.jit(tfm.shard_params)(params)
+        got = jax.jit(lambda p, t: tfm.apply(p, t, ring_cfg))(ps, toks)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=2e-4
+    )

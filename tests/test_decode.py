@@ -245,3 +245,71 @@ def test_sample_logits_sequential_topk_then_topp():
         )(logits, keys)
     )
     assert set(toks) == {0}
+
+
+# -- speculative decoding ----------------------------------------------------
+
+
+def test_speculative_greedy_matches_target_greedy(params):
+    """The defining property: greedy speculative output is bit-identical
+    to plain greedy decoding of the TARGET, for any draft."""
+    draft_cfg = tfm.TransformerConfig(
+        vocab_size=CFG.vocab_size, d_model=16, n_layers=1, n_heads=2,
+        n_kv_heads=2, d_ff=32, max_seq=32, dtype=jnp.float32,
+    )
+    draft = tfm.init(jax.random.PRNGKey(9), draft_cfg)
+    prompt = jnp.asarray([[3, 7, 1]], jnp.int32)
+    ref = np.asarray(decode.generate(params, prompt, CFG, 10))
+    for gamma in (1, 3, 5):
+        out = decode.speculative_generate(
+            draft, draft_cfg, params, CFG, prompt, 10, gamma=gamma
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out), ref, err_msg=f"gamma={gamma}"
+        )
+
+
+def test_speculative_self_draft_accepts_everything(params):
+    """Draft == target, greedy: every proposal verifies, so acceptance is
+    100% and the token cost per round is gamma+1."""
+    prompt = jnp.asarray([[5, 2]], jnp.int32)
+    out, stats = decode.speculative_generate(
+        params, CFG, params, CFG, prompt, 12, gamma=4, return_stats=True
+    )
+    ref = np.asarray(decode.generate(params, prompt, CFG, 12))
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert stats["accepted"] == stats["drafted"], stats
+    # all-accept rounds commit gamma+1 tokens each
+    assert stats["rounds"] == -(-12 // 5), stats
+
+
+def test_speculative_sampled_valid_and_deterministic(params):
+    draft_cfg = tfm.TransformerConfig(
+        vocab_size=CFG.vocab_size, d_model=16, n_layers=1, n_heads=2,
+        n_kv_heads=2, d_ff=32, max_seq=32, dtype=jnp.float32,
+    )
+    draft = tfm.init(jax.random.PRNGKey(10), draft_cfg)
+    prompt = jnp.asarray([[1, 4, 9]], jnp.int32)
+    a = decode.speculative_generate(
+        draft, draft_cfg, params, CFG, prompt, 8, gamma=3,
+        temperature=0.8, rng=jax.random.PRNGKey(5),
+    )
+    b = decode.speculative_generate(
+        draft, draft_cfg, params, CFG, prompt, 8, gamma=3,
+        temperature=0.8, rng=jax.random.PRNGKey(5),
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    arr = np.asarray(a)
+    assert arr.shape == (1, 11)
+    assert np.all((arr >= 0) & (arr < CFG.vocab_size))
+
+
+def test_speculative_validation_errors(params):
+    with pytest.raises(ValueError, match="single-stream"):
+        decode.speculative_generate(
+            params, CFG, params, CFG, jnp.zeros((2, 4), jnp.int32), 4
+        )
+    with pytest.raises(ValueError, match=">= 2"):
+        decode.speculative_generate(
+            params, CFG, params, CFG, jnp.zeros((1, 1), jnp.int32), 4
+        )

@@ -74,7 +74,7 @@ def apply_cached(
     positions = jnp.broadcast_to(
         idx + jnp.arange(L, dtype=jnp.int32), (B, L)
     )
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = tfm.embed_lookup(params["embed"], tokens, cfg.dtype)
 
     def step(x, layer):
         bp, ck, cv = layer
@@ -90,7 +90,7 @@ def apply_cached(
     logits = jnp.einsum(
         "bld,dv->blv",
         x,
-        params["lm_head"].astype(cfg.dtype),
+        tfm.weight(params["lm_head"], cfg.dtype),
         preferred_element_type=jnp.float32,
     )
     return logits, {"k": cks, "v": cvs, "index": idx + L}

@@ -186,7 +186,7 @@ def layer_routing_stats(params, tokens: jnp.ndarray, cfg, layer: int = 0) -> dic
 
     B, L = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = tfm.embed_lookup(params["embed"], tokens, cfg.dtype)
     blocks = params["blocks"]
     for i in range(layer):
         bp_i = jax.tree_util.tree_map(lambda a: a[i], blocks)
@@ -204,7 +204,7 @@ def moe_mlp(bp, y: jnp.ndarray, cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
     aux [])``.  ``bp`` holds ``router`` [D, E], ``we_gate``/``we_up``
     [E, D, F], ``we_down`` [E, F, D].
     """
-    from .transformer import shard
+    from .transformer import shard, weight
 
     B, L, D = y.shape
     dt = cfg.dtype
@@ -219,16 +219,16 @@ def moe_mlp(bp, y: jnp.ndarray, cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
     ex_in = shard(ex_in, "ep", ("dp", "sp"), None, None)
 
     h_gate = jnp.einsum(
-        "egcd,edf->egcf", ex_in, bp["we_gate"].astype(dt),
+        "egcd,edf->egcf", ex_in, weight(bp["we_gate"], dt),
         preferred_element_type=jnp.float32,
     ).astype(dt)
     h_up = jnp.einsum(
-        "egcd,edf->egcf", ex_in, bp["we_up"].astype(dt),
+        "egcd,edf->egcf", ex_in, weight(bp["we_up"], dt),
         preferred_element_type=jnp.float32,
     ).astype(dt)
     h = shard(jax.nn.silu(h_gate) * h_up, "ep", ("dp", "sp"), None, "tp")
     ex_out = jnp.einsum(
-        "egcf,efd->egcd", h, bp["we_down"].astype(dt),
+        "egcf,efd->egcd", h, weight(bp["we_down"], dt),
         preferred_element_type=jnp.float32,
     ).astype(dt)
     ex_out = shard(ex_out, "ep", ("dp", "sp"), None, None)

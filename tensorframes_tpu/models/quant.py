@@ -1,0 +1,83 @@
+"""Weight-only int8 quantization for inference.
+
+Net-new vs the reference (frozen-graph scoring is f32 — SURVEY §2.6); on
+TPU the single-stream decode loop is HBM-bandwidth-bound (every step
+streams all weights for one token), so halving/quartering weight bytes is
+a direct latency and capacity win.  Design:
+
+* **symmetric per-channel int8**: each output channel (or embedding row)
+  gets ``scale = max|w| / 127``; values are rounded to int8.  No
+  activation quantization — matmuls dequantise on the fly
+  (``w.q.astype(bf16) * w.scale``), which XLA fuses into the matmul's
+  operand read, keeping the MXU path intact;
+* weights live in HBM as int8 (4x smaller than f32 params, 2x smaller
+  than bf16), dequantised tile-by-tile in VMEM — the bandwidth saving is
+  the point, not int8 arithmetic;
+* ``QTensor`` is a NamedTuple (automatically a jax pytree), so quantized
+  param trees jit/donate/checkpoint like any other; the model reads
+  weights through ``transformer.weight``/``embed_lookup`` which accept
+  either form.
+
+Quantized params are an INFERENCE artifact (decode/scoring, single chip
+or replicated): ``shard_params``/training keep full precision.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import Params, QTensor
+
+# weights quantized per output channel (reduce |w| over the contracted,
+# second-to-last axis); everything else (norms, router, biases) stays f32
+_PER_OUT = {
+    "wq", "wk", "wv", "wo",
+    "w_gate", "w_up", "w_down",
+    "we_gate", "we_up", "we_down",
+    "lm_head",
+}
+
+
+def quantize(w: jnp.ndarray, axis: int = -2) -> QTensor:
+    """Symmetric int8 quantization of ``w`` with a scale per slice along
+    every axis except ``axis`` (the contracted one)."""
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = (amax / 127.0).astype(jnp.float32)
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(w / safe), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=jnp.where(scale == 0.0, 0.0, scale))
+
+
+def dequantize(w: "QTensor | jnp.ndarray", dtype: Any = jnp.float32):
+    """Alias of the model's weight accessor — ONE dequantisation
+    definition (transformer.weight) so numerics cannot fork."""
+    from .transformer import weight
+
+    return weight(w, dtype)
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize the matmul weights of a transformer param tree.
+
+    ``embed`` is quantized per ROW (rows are gathered by token id, so the
+    scale must follow the gather); the projections per output channel.
+    Norm gains and the MoE router stay full precision (tiny, and the
+    router's softmax is precision-sensitive)."""
+    out = dict(params)
+    out["embed"] = quantize(params["embed"], axis=-1)
+    out["lm_head"] = quantize(params["lm_head"], axis=-2)
+    blocks = {}
+    for k, w in params["blocks"].items():
+        blocks[k] = quantize(w, axis=-2) if k in _PER_OUT else w
+    out["blocks"] = blocks
+    return out
+
+
+def param_bytes(params: Params) -> int:
+    """Total bytes of a (possibly quantized) param tree."""
+    return sum(
+        a.nbytes for a in jax.tree_util.tree_leaves(params)
+    )

@@ -33,7 +33,7 @@ constraints over axes absent from the ambient mesh are dropped.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,33 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 Params = Dict[str, Any]
+
+
+class QTensor(NamedTuple):
+    """An int8-quantized weight: ``q`` int8 values + broadcastable f32
+    ``scale`` (per output channel / embedding row — ``models/quant.py``).
+    A NamedTuple, so param trees holding these remain ordinary pytrees."""
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+
+
+def weight(w: "QTensor | jnp.ndarray", dt) -> jnp.ndarray:
+    """Weight accessor: dequantise a QTensor to ``dt`` (XLA fuses the
+    int8->dt multiply into the consuming matmul's operand read) or cast a
+    plain array."""
+    if isinstance(w, QTensor):
+        return w.q.astype(dt) * w.scale.astype(dt)
+    return w.astype(dt)
+
+
+def embed_lookup(emb: "QTensor | jnp.ndarray", tokens, dt) -> jnp.ndarray:
+    """Token-row gather that never materialises a dequantised [V, D]
+    table: int8 rows gather first, then scale by the gathered per-row
+    scales."""
+    if isinstance(emb, QTensor):
+        return emb.q[tokens].astype(dt) * emb.scale[tokens].astype(dt)
+    return emb.astype(dt)[tokens]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -226,12 +253,18 @@ def block_spec(name: str, lead_dims: int = 1) -> tuple:
 def shard_params(params: Params) -> Params:
     """Apply the canonical tp/ep layout constraints to a param pytree
     (no-op without an ambient mesh).  The pipeline layer adds the ``pp``
-    lead-axis sharding on top (``train.py``)."""
+    lead-axis sharding on top (``train.py``).  Quantized (QTensor) leaves
+    pass through unsharded — they are a single-chip/replicated inference
+    artifact (``models/quant.py``)."""
+
+    def s_(v, *spec):
+        return v if isinstance(v, QTensor) else shard(v, *spec)
+
     p = dict(params)
-    p["embed"] = shard(params["embed"], "tp", None)
-    p["lm_head"] = shard(params["lm_head"], None, "tp")
+    p["embed"] = s_(params["embed"], "tp", None)
+    p["lm_head"] = s_(params["lm_head"], None, "tp")
     p["blocks"] = {
-        k: shard(v, *block_spec(k)) for k, v in params["blocks"].items()
+        k: s_(v, *block_spec(k)) for k, v in params["blocks"].items()
     }
     return p
 
@@ -295,10 +328,10 @@ def _block(
         ff_out, aux = moe_mlp(bp, y, cfg)
         x = x + ff_out
     else:
-        gate = jax.nn.silu(y @ bp["w_gate"].astype(dt))
-        up = y @ bp["w_up"].astype(dt)
+        gate = jax.nn.silu(y @ weight(bp["w_gate"], dt))
+        up = y @ weight(bp["w_up"], dt)
         ff = shard(gate * up, ("dp", "ep"), "sp", "tp")
-        x = x + shard(ff @ bp["w_down"].astype(dt), ("dp", "ep"), "sp", None)
+        x = x + shard(ff @ weight(bp["w_down"], dt), ("dp", "ep"), "sp", None)
         aux = jnp.zeros((), jnp.float32)
     if kv is not None:
         return x, cache, aux
@@ -314,9 +347,9 @@ def _attn_residual(bp, x, positions, cfg, kv=None):
     h, kvh, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
     y = _rms_norm(x, bp["ln1"])
-    q = (y @ bp["wq"].astype(dt)).reshape(B, L, h, dh)
-    k = (y @ bp["wk"].astype(dt)).reshape(B, L, kvh, dh)
-    v = (y @ bp["wv"].astype(dt)).reshape(B, L, kvh, dh)
+    q = (y @ weight(bp["wq"], dt)).reshape(B, L, h, dh)
+    k = (y @ weight(bp["wk"], dt)).reshape(B, L, kvh, dh)
+    v = (y @ weight(bp["wv"], dt)).reshape(B, L, kvh, dh)
     q = shard(_rope(q, positions, cfg.rope_theta), ("dp", "ep"), "sp", "tp", None)
     k = shard(_rope(k, positions, cfg.rope_theta), ("dp", "ep"), "sp", "tp", None)
     v = shard(v, ("dp", "ep"), "sp", "tp", None)
@@ -347,7 +380,7 @@ def _attn_residual(bp, x, positions, cfg, kv=None):
             v = jnp.repeat(v, h // kvh, axis=2)
         att = full_attention(q, k, v, True, positions, positions)
     att = att.reshape(B, L, h * dh)
-    x = x + shard(att @ bp["wo"].astype(dt), ("dp", "ep"), "sp", None)
+    x = x + shard(att @ weight(bp["wo"], dt), ("dp", "ep"), "sp", None)
     return x, ((ck, cv) if kv is not None else None)
 
 
@@ -469,14 +502,14 @@ def apply(
         positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
     if blocks_runner is None:
         blocks_runner = apply_blocks
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
     x = shard(x, ("dp", "ep"), "sp", None)
     x, aux = blocks_runner(params["blocks"], x, positions, cfg)
     x = _rms_norm(x, params["ln_f"])
     logits = jnp.einsum(
         "bld,dv->blv",
         x,
-        params["lm_head"].astype(cfg.dtype),
+        weight(params["lm_head"], cfg.dtype),
         preferred_element_type=jnp.float32,
     )
     logits = shard(logits, ("dp", "ep"), "sp", "tp")

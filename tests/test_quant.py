@@ -1,0 +1,120 @@
+"""Weight-only int8 inference quantization (models/quant.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorframes_tpu.models import decode, quant
+from tensorframes_tpu.models import transformer as tfm
+from tensorframes_tpu.models.transformer import QTensor
+
+
+def cfg_(**kw):
+    base = dict(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq=16, dtype=jnp.float32,
+    )
+    base.update(kw)
+    return tfm.TransformerConfig(**base)
+
+
+def test_quantize_roundtrip_error_bounded():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(64, 128).astype(np.float32))
+    qt = quant.quantize(w)
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (1, 128)
+    back = quant.dequantize(qt)
+    # symmetric int8: error <= scale/2 per element
+    bound = np.asarray(qt.scale)[0] / 2 + 1e-7
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    assert np.all(err <= bound[None, :])
+
+
+def test_quantize_zero_channel():
+    w = jnp.zeros((8, 4))
+    qt = quant.quantize(w)
+    np.testing.assert_array_equal(np.asarray(quant.dequantize(qt)), 0.0)
+
+
+def test_quantized_params_smaller_and_close():
+    cfg = cfg_()
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    qp = quant.quantize_params(params)
+    assert quant.param_bytes(qp) < quant.param_bytes(params) / 3
+    # norms stay full precision
+    assert not isinstance(qp["blocks"]["ln1"], QTensor)
+    assert isinstance(qp["blocks"]["wq"], QTensor)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    lf = np.asarray(tfm.apply(params, toks, cfg))
+    lq = np.asarray(tfm.apply(qp, toks, cfg))
+    # int8 weight noise: logits stay close in an absolute sense and the
+    # rankings broadly agree (same top-1 on most positions)
+    assert np.abs(lf - lq).max() < 0.5
+    agree = (lf.argmax(-1) == lq.argmax(-1)).mean()
+    assert agree > 0.7, agree
+
+
+def test_quantized_generate_and_cache_paths():
+    cfg = cfg_()
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    qp = quant.quantize_params(params)
+    prompt = jnp.asarray([[3, 1, 4]], jnp.int32)
+    out = decode.generate(qp, prompt, cfg, 6)
+    assert out.shape == (1, 9)
+    # cache path logits == full-forward logits for the SAME quantized
+    # params (quantization must not break the incremental invariant)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (1, 8), 0, 64)
+    full = np.asarray(tfm.apply(qp, toks, cfg))
+    cache = decode.init_cache(cfg, 1, 8)
+    inc, _ = decode.apply_cached(qp, toks, cache, cfg)
+    np.testing.assert_allclose(np.asarray(inc), full, atol=2e-5)
+
+
+def test_quantized_moe_params():
+    cfg = cfg_(moe_experts=4)
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    qp = quant.quantize_params(params)
+    assert isinstance(qp["blocks"]["we_gate"], QTensor)
+    assert not isinstance(qp["blocks"]["router"], QTensor)  # stays f32
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    lq = tfm.apply(qp, toks, cfg)
+    assert np.all(np.isfinite(np.asarray(lq)))
+
+
+def test_quantized_scoring_through_verbs():
+    """The frozen-scoring integration: quantized flagship weights serve
+    per-row NLL through map_blocks like full-precision ones."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.models import scoring
+
+    cfg = cfg_()
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    qp = quant.quantize_params(params)
+    toks = np.random.RandomState(0).randint(0, 64, (12, 9)).astype(np.int32)
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"tokens": toks}, num_blocks=2)
+    )
+    full = tfs.map_blocks(scoring.scoring_program(params, cfg), frame)
+    qout = tfs.map_blocks(scoring.scoring_program(qp, cfg), frame)
+    a = np.asarray(full.to_arrays()["nll"])
+    b = np.asarray(qout.to_arrays()["nll"])
+    np.testing.assert_allclose(a, b, atol=0.05)
+
+
+def test_jit_through_quantized_tree():
+    cfg = cfg_()
+    qp = quant.quantize_params(tfm.init(jax.random.PRNGKey(0), cfg))
+    toks = jnp.zeros((1, 8), jnp.int32)
+    out = jax.jit(lambda p, t: tfm.apply(p, t, cfg))(qp, toks)
+    assert out.shape == (1, 8, 64)
+
+
+def test_layer_routing_stats_on_quantized_params():
+    from tensorframes_tpu.models import moe
+
+    cfg = cfg_(moe_experts=4)
+    qp = quant.quantize_params(tfm.init(jax.random.PRNGKey(0), cfg))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    stats = moe.layer_routing_stats(qp, toks, cfg, layer=0)
+    np.testing.assert_allclose(stats["load"].sum(), 1.0, rtol=1e-6)

@@ -24,6 +24,7 @@ if __name__ == "__main__":
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 import tensorframes_tpu as tfs  # noqa: E402
@@ -41,6 +42,15 @@ def make_cfg():
     return tfm.TransformerConfig(
         vocab_size=32, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
         d_ff=64, max_seq=16,
+    )
+
+
+def make_moe_cfg():
+    return tfm.TransformerConfig(
+        vocab_size=32, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        max_seq=16, moe_experts=4, moe_top_k=2, moe_d_ff=48,
+        moe_capacity_factor=8.0,  # no drops: cross-process parity is exact
+        dtype=jnp.float32,
     )
 
 
@@ -93,6 +103,28 @@ def main(coordinator: str, pid: int, out_path: str) -> None:
         _, _, loss = step(params, opt_state, tokens, targets)
         loss = float(loss)
 
+    # ---- MoE train step with experts sharded over ep ACROSS processes ----
+    # dp=2 x ep=2 x tp=2 over the 8 global devices: the dispatch
+    # all-to-all crosses the process boundary (the DCN-analog path)
+    moe_mesh = training_mesh(dp=2, ep=2, tp=2)
+    moe_cfg = make_moe_cfg()
+    with jax.set_mesh(moe_mesh):
+        mparams = tfm.shard_params(tfm.init(jax.random.PRNGKey(1), moe_cfg))
+        mstep, mtx = train.make_train_step(moe_cfg, train.TrainConfig())
+        mopt = mtx.init(mparams)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        g_toks = jax.make_array_from_process_local_data(
+            NamedSharding(moe_mesh, P(("dp", "ep"))),
+            np.asarray(toks)[pid * 8 : (pid + 1) * 8],
+        )
+        g_tgts = jax.make_array_from_process_local_data(
+            NamedSharding(moe_mesh, P(("dp", "ep"))),
+            np.roll(np.asarray(toks), -1, 1)[pid * 8 : (pid + 1) * 8],
+        )
+        _, _, mloss = mstep(mparams, mopt, g_toks, g_tgts)
+        mloss = float(mloss)
+
     if pid == 0:
         with open(out_path, "w") as f:
             json.dump(
@@ -102,6 +134,7 @@ def main(coordinator: str, pid: int, out_path: str) -> None:
                     "local_devices": jax.local_device_count(),
                     "reduce_sum": total,
                     "train_loss": loss,
+                    "moe_train_loss": mloss,
                 },
                 f,
             )

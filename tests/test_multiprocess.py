@@ -102,3 +102,26 @@ def test_cross_process_train_step_matches_single_process(mp_results):
         tokens, targets = lm_split({"tokens": jax.numpy.asarray(toks)})
         _, _, loss = step(params, opt_state, tokens, targets)
     assert mp_results["train_loss"] == pytest.approx(float(loss), rel=1e-4)
+
+
+def test_cross_process_moe_ep_step_matches_single_process(mp_results):
+    """MoE with experts sharded over ep ACROSS the two processes (the
+    dispatch all-to-all crosses the process boundary) reproduces the
+    single-process loss."""
+    from tensorframes_tpu import train
+    from tensorframes_tpu.models import transformer as tfm
+    from tensorframes_tpu.parallel.mesh import training_mesh
+
+    cfg = _mp_worker.make_moe_cfg()
+    _, toks = _mp_worker.make_data()
+    toks = jax.numpy.asarray(toks)
+    tgts = jax.numpy.roll(toks, -1, 1)
+    mesh = training_mesh(dp=2, ep=2, tp=2)
+    with jax.set_mesh(mesh):
+        params = tfm.shard_params(tfm.init(jax.random.PRNGKey(1), cfg))
+        step, tx = train.make_train_step(cfg, train.TrainConfig())
+        opt_state = tx.init(params)
+        _, _, loss = step(params, opt_state, toks, tgts)
+    assert mp_results["moe_train_loss"] == pytest.approx(
+        float(loss), rel=1e-4
+    )

@@ -118,3 +118,20 @@ def test_layer_routing_stats_on_quantized_params():
     toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
     stats = moe.layer_routing_stats(qp, toks, cfg, layer=0)
     np.testing.assert_allclose(stats["load"].sum(), 1.0, rtol=1e-6)
+
+
+def test_quantized_tree_checkpoints(tmp_path):
+    """QTensor leaves survive an orbax save/restore round trip (they are
+    plain pytrees of int8 + f32 arrays)."""
+    from tensorframes_tpu.checkpoint import Checkpointer
+
+    cfg = cfg_()
+    qp = quant.quantize_params(tfm.init(jax.random.PRNGKey(0), cfg))
+    ck = Checkpointer(str(tmp_path / "q"))
+    ck.save(0, qp, wait=True)
+    restored = ck.restore(0, target=qp)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(qp), jax.tree_util.tree_leaves(restored)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ck.close()

@@ -147,3 +147,81 @@ def lm_split(batch: Mapping[str, object], column: str = "tokens"):
     next-token objective (``train.make_train_step`` signature)."""
     toks = batch[column]
     return toks[:, :-1], toks[:, 1:]
+
+
+def pack_examples(
+    examples: Sequence[np.ndarray],
+    seq_len: int,
+    pad_id: int = 0,
+):
+    """Greedy first-fit packing of variable-length token sequences into
+    fixed [N, seq_len] rows — no per-example padding waste, the standard
+    LM pretraining input shape (static shapes for XLA; the attention mask
+    keeps segments independent — ``transformer.apply(segment_ids=...)``).
+
+    Returns ``(tokens, segment_ids, positions)`` int32 arrays:
+
+    * ``tokens``: packed ids, ``pad_id`` in underfull tails;
+    * ``segment_ids``: 1, 2, ... per example within a row, 0 = padding;
+    * ``positions``: restart at 0 at each segment start (RoPE sees every
+      example from its own origin).
+
+    Examples longer than ``seq_len`` are split into ``seq_len`` chunks
+    (each chunk becomes its own segment).
+    """
+    pieces: List[np.ndarray] = []
+    for ex in examples:
+        ex = np.asarray(ex).ravel()
+        for i in range(0, len(ex), seq_len):
+            pieces.append(ex[i : i + seq_len])
+    # first-fit with rows BUCKETED by remaining space: placing a piece is
+    # an O(seq_len) bucket scan instead of a scan over all open rows, so
+    # packing stays linear in corpus size (review r3)
+    rows: List[List[np.ndarray]] = []
+    space: List[int] = []
+    by_space: Dict[int, List[int]] = {}
+    for p in pieces:
+        need = len(p)
+        r = None
+        for free in range(need, seq_len + 1):
+            bucket = by_space.get(free)
+            if bucket:
+                r = bucket.pop()
+                break
+        if r is None:
+            rows.append([])
+            space.append(seq_len)
+            r = len(rows) - 1
+        rows[r].append(p)
+        space[r] -= need
+        if space[r] > 0:
+            by_space.setdefault(space[r], []).append(r)
+    N = len(rows)
+    tokens = np.full((N, seq_len), pad_id, np.int32)
+    segments = np.zeros((N, seq_len), np.int32)
+    positions = np.zeros((N, seq_len), np.int32)
+    for r, segs in enumerate(rows):
+        at = 0
+        for s, p in enumerate(segs, start=1):
+            tokens[r, at : at + len(p)] = p
+            segments[r, at : at + len(p)] = s
+            positions[r, at : at + len(p)] = np.arange(len(p))
+            at += len(p)
+    return tokens, segments, positions
+
+
+def lm_split_packed(tokens, segment_ids, positions):
+    """Packed [N, L] arrays -> (inputs, targets, segs, pos) for the
+    next-token objective: the target at position i is token i+1 ONLY when
+    both belong to the same (non-padding) segment; everything else is -1
+    (ignored by ``transformer.cross_entropy``)."""
+    tokens = np.asarray(tokens)
+    segment_ids = np.asarray(segment_ids)
+    positions = np.asarray(positions)
+    inp = tokens[:, :-1]
+    tgt = tokens[:, 1:].astype(np.int32).copy()
+    same = (segment_ids[:, 1:] == segment_ids[:, :-1]) & (
+        segment_ids[:, :-1] > 0
+    )
+    tgt[~same] = -1
+    return inp, tgt, segment_ids[:, :-1], positions[:, :-1]

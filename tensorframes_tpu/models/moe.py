@@ -57,12 +57,16 @@ def capacity(
 
 
 def gate(
-    probs: jnp.ndarray, top_k: int, cap: int
+    probs: jnp.ndarray, top_k: int, cap: int, valid=None
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Top-k capacity gating.
 
     ``probs`` [G, S, E] f32 (softmaxed router output) ->
     ``(dispatch [G, S, E, C], combine [G, S, E, C], aux [])``, all f32.
+    ``valid`` [G, S] (optional) marks real tokens: padding (packed
+    batches, ``data.pack_examples`` segment 0) neither claims capacity
+    slots nor contributes to the load-balance statistics — otherwise pad
+    garbage could evict real tokens and bias the aux loss.
 
     Slot assignment is rank-major then token-major (all rank-0 choices
     claim slots before any rank-1 choice, each in token order) — the
@@ -76,11 +80,15 @@ def gate(
     re-scaled.
     """
     G, S, E = probs.shape
+    if valid is not None:
+        vmask = valid.astype(probs.dtype)[..., None]  # [G, S, 1]
     picks = []  # (onehot [G,S,E], prob [G,S]) per rank
     masked = probs
     for _ in range(top_k):
         idx = jnp.argmax(masked, axis=-1)
         oh = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+        if valid is not None:
+            oh = oh * vmask  # pad picks vanish: no slot, no weight
         picks.append((oh, jnp.sum(masked * oh, axis=-1)))
         # exclude the pick with a negative sentinel, not *0: a saturated
         # f32 softmax can underflow every other expert to exactly 0.0,
@@ -109,9 +117,14 @@ def gate(
 
     # Switch load-balance loss on the PRE-capacity assignment (drops are a
     # capacity artefact; the router should be pushed toward balance, not
-    # toward whatever the drops left behind)
-    f = jnp.mean(picks[0][0], axis=(0, 1))  # top-1 fraction per expert
-    p_mean = jnp.mean(probs, axis=(0, 1))
+    # toward whatever the drops left behind); statistics over REAL tokens
+    if valid is not None:
+        n = jnp.maximum(jnp.sum(vmask), 1.0)
+        f = jnp.sum(picks[0][0], axis=(0, 1)) / n
+        p_mean = jnp.sum(probs * vmask, axis=(0, 1)) / n
+    else:
+        f = jnp.mean(picks[0][0], axis=(0, 1))  # top-1 fraction per expert
+        p_mean = jnp.mean(probs, axis=(0, 1))
     aux = E * jnp.sum(f * p_mean)
     return dispatch, combine, aux
 
@@ -129,7 +142,7 @@ def _sp_groups(L: int) -> int:
     return sp if sp > 1 and L % sp == 0 else 1
 
 
-def _route(bp, y: jnp.ndarray, cfg):
+def _route(bp, y: jnp.ndarray, cfg, segments=None):
     """The routing prologue shared by the executed layer (``moe_mlp``) and
     the diagnostics (``routing_stats``) — ONE definition so observability
     can never silently diverge from what the model runs.
@@ -148,7 +161,10 @@ def _route(bp, y: jnp.ndarray, cfg):
     )
     probs = jax.nn.softmax(logits, axis=-1)
     cap = capacity(S, cfg.moe_top_k, E, cfg.moe_capacity_factor)
-    dispatch, combine, aux = gate(probs, cfg.moe_top_k, cap)
+    valid = None
+    if segments is not None:
+        valid = segments.reshape(G, S) > 0
+    dispatch, combine, aux = gate(probs, cfg.moe_top_k, cap, valid)
     return yg, probs, dispatch, combine, aux, cap
 
 
@@ -197,7 +213,9 @@ def layer_routing_stats(params, tokens: jnp.ndarray, cfg, layer: int = 0) -> dic
     return routing_stats(bp, y, cfg)
 
 
-def moe_mlp(bp, y: jnp.ndarray, cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def moe_mlp(
+    bp, y: jnp.ndarray, cfg, segments=None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The MoE replacement for the dense SwiGLU block.
 
     ``y`` [B, L, D] (post-RMSNorm activations) -> ``(out [B, L, D],
@@ -208,7 +226,7 @@ def moe_mlp(bp, y: jnp.ndarray, cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
     B, L, D = y.shape
     dt = cfg.dtype
-    yg, _probs, dispatch, combine, aux, _cap = _route(bp, y, cfg)
+    yg, _probs, dispatch, combine, aux, _cap = _route(bp, y, cfg, segments)
 
     # groups -> per-expert buffers: the E axis picks up the ep sharding the
     # G axis loses — GSPMD's cue for the dispatch all-to-all

@@ -303,6 +303,7 @@ def _block(
     positions: jnp.ndarray,
     cfg: TransformerConfig,
     kv=None,
+    segments=None,
 ):
     """One decoder block.  x: [B, L, D] (L may be the sp-local chunk when
     ring attention is on — positions carry the global offsets).
@@ -317,7 +318,7 @@ def _block(
     Returns ``(x', aux)`` — ``aux`` is the block's MoE load-balance loss
     (f32 scalar, 0 for dense blocks) — or ``(x', (ck, cv), aux)`` when
     caching."""
-    x, cache = _attn_residual(bp, x, positions, cfg, kv)
+    x, cache = _attn_residual(bp, x, positions, cfg, kv, segments)
     dt = cfg.dtype
 
     # -- MLP: dense SwiGLU or mixture of experts ----------------------------
@@ -325,7 +326,7 @@ def _block(
     if cfg.moe_experts:
         from .moe import moe_mlp
 
-        ff_out, aux = moe_mlp(bp, y, cfg)
+        ff_out, aux = moe_mlp(bp, y, cfg, segments)
         x = x + ff_out
     else:
         gate = jax.nn.silu(y @ weight(bp["w_gate"], dt))
@@ -338,7 +339,7 @@ def _block(
     return x, aux
 
 
-def _attn_residual(bp, x, positions, cfg, kv=None):
+def _attn_residual(bp, x, positions, cfg, kv=None, segments=None):
     """The attention half of a block: x -> x + Wo(attn(...)).  Returns
     ``(x', cache)`` (cache None outside decode).  Split out of ``_block``
     so diagnostics (``moe.layer_routing_stats``) can reproduce the EXACT
@@ -378,7 +379,9 @@ def _attn_residual(bp, x, positions, cfg, kv=None):
         if kvh != h:
             k = jnp.repeat(k, h // kvh, axis=2)
             v = jnp.repeat(v, h // kvh, axis=2)
-        att = full_attention(q, k, v, True, positions, positions)
+        att = full_attention(
+            q, k, v, True, positions, positions, segments, segments
+        )
     att = att.reshape(B, L, h * dh)
     x = x + shard(att @ weight(bp["wo"], dt), ("dp", "ep"), "sp", None)
     return x, ((ck, cv) if kv is not None else None)
@@ -415,6 +418,7 @@ def apply_blocks(
     x: jnp.ndarray,
     positions: jnp.ndarray,
     cfg: TransformerConfig,
+    segments=None,
 ) -> "tuple[jnp.ndarray, jnp.ndarray]":
     """Scan the stacked block params over x — one trace for all layers.
 
@@ -427,7 +431,7 @@ def apply_blocks(
 
     def step(carry, bp):
         x, aux = carry
-        x, a = body(bp, x, positions, cfg)
+        x, a = body(bp, x, positions, cfg, None, segments)
         return (x, aux + a), None
 
     (out, aux), _ = jax.lax.scan(
@@ -444,18 +448,34 @@ def apply(
     blocks_runner=None,
     return_hidden: bool = False,
     return_aux: bool = False,
+    segment_ids: Optional[jnp.ndarray] = None,
 ) -> "jnp.ndarray | tuple[jnp.ndarray, ...]":
     """tokens [B, L] int32 -> logits [B, L, V] (f32).
 
-    ``blocks_runner(blocks, x, positions, cfg) -> (x, aux)`` overrides how
-    the decoder stack runs (default sequential ``apply_blocks``; the
-    training layer passes the GPipe pipeline, ``train.pipelined_blocks``).
+    ``blocks_runner(blocks, x, positions, cfg, segments=None) -> (x,
+    aux)`` overrides how the decoder stack runs (default sequential
+    ``apply_blocks``; the training layer passes the GPipe pipeline,
+    ``train.pipelined_blocks``).
     ``return_hidden=True`` also returns the final-norm hidden states
     [B, L, D] (the embedding surface for scoring programs);
     ``return_aux=True`` appends the MoE load-balance aux loss (f32
     scalar, 0 for dense models).  Extras are appended in
-    (hidden, aux) order."""
+    (hidden, aux) order.
+
+    ``segment_ids`` [B, L] enables packed-sequence training
+    (``data.pack_examples``): attention stays within each segment (id 0 =
+    padding); pass the matching restart ``positions``.  Packed batches
+    require the full-attention path (the Pallas/ring kernels mask by
+    row-major chunk offsets)."""
     B, L = tokens.shape
+    if segment_ids is not None and cfg.attn_impl in (
+        "flash", "ring", "ring_flash",
+    ):
+        raise ValueError(
+            f"attn_impl={cfg.attn_impl!r} cannot honour segment_ids "
+            f"(packed sequences need the explicit mask); use "
+            f"attn_impl='full' or 'auto'"
+        )
     if cfg.attn_impl == "auto":
         # kernel choice by mesh + length (VERDICT r2 weak #2).  Under an
         # ambient mesh with a real sp axis the sequence arrives sharded, so
@@ -473,7 +493,7 @@ def apply(
         if sp > 1:
             from ..parallel.flash import chunk_supported
 
-            if positions is not None or L % sp:
+            if positions is not None or segment_ids is not None or L % sp:
                 # ring masking derives global offsets from chunk indices
                 # (row-major) and its shard_map needs L divisible by sp;
                 # custom positions / ragged lengths take the explicit
@@ -484,7 +504,11 @@ def apply(
             else:
                 resolved = "ring"
         else:
-            use_flash = positions is None and L >= cfg.flash_min_len
+            use_flash = (
+                positions is None
+                and segment_ids is None
+                and L >= cfg.flash_min_len
+            )
             resolved = "flash" if use_flash else "full"
         cfg = dataclasses.replace(cfg, attn_impl=resolved)
     if positions is not None and cfg.attn_impl in (
@@ -504,7 +528,7 @@ def apply(
         blocks_runner = apply_blocks
     x = embed_lookup(params["embed"], tokens, cfg.dtype)
     x = shard(x, ("dp", "ep"), "sp", None)
-    x, aux = blocks_runner(params["blocks"], x, positions, cfg)
+    x, aux = blocks_runner(params["blocks"], x, positions, cfg, segment_ids)
     x = _rms_norm(x, params["ln_f"])
     logits = jnp.einsum(
         "bld,dv->blv",
@@ -536,11 +560,17 @@ def loss_fn(
     targets: jnp.ndarray,
     cfg: TransformerConfig,
     blocks_runner=None,
+    positions: Optional[jnp.ndarray] = None,
+    segment_ids: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Mean next-token cross-entropy (+ weighted MoE load-balance aux when
-    the config is sparse).  targets [B, L] int32 (-1 = ignore)."""
+    the config is sparse).  targets [B, L] int32 (-1 = ignore); pass
+    ``positions``/``segment_ids`` from ``data.lm_split_packed`` for
+    packed batches (cross-segment targets arrive pre-masked as -1)."""
     logits, aux = apply(
-        params, tokens, cfg, blocks_runner=blocks_runner, return_aux=True
+        params, tokens, cfg, positions=positions,
+        blocks_runner=blocks_runner, return_aux=True,
+        segment_ids=segment_ids,
     )
     loss = cross_entropy(logits, targets)
     if cfg.moe_experts:

@@ -335,18 +335,27 @@ def ring_attention(
     )(q, k, v)
 
 
-def full_attention(q, k, v, causal, positions_q=None, positions_k=None):
+def full_attention(
+    q, k, v, causal, positions_q=None, positions_k=None,
+    segments_q=None, segments_k=None,
+):
     """The reference (non-ring) attention kernel: q [B, Lq, H, Dh],
     k/v [B, Lk, H, Dh] (kv heads already repeated), f32 softmax, bf16
     matmuls with f32 accumulation.  The single home of the numerics policy —
     the transformer's full-attention path and the ring fallback both use it.
 
     ``positions_*``: [B, L] absolute positions for the causal mask; defaults
-    to ``arange``."""
+    to ``arange``.  ``segments_*``: [B, L] packed-sequence segment ids —
+    tokens attend only within their own segment (``data.pack_examples``).
+    Padding tokens all share segment 0, so they attend among themselves
+    and produce garbage mixtures of pad embeddings — harmless: real
+    tokens never see segment 0, pad targets are -1, and MoE routing
+    excludes them (``moe.gate(valid=...)``)."""
     scale = np.float32(1.0 / np.sqrt(q.shape[-1]))  # f32: no x64 promotion
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
+    mask = None
     if causal:
         if positions_q is None:
             mask = (
@@ -357,6 +366,12 @@ def full_attention(q, k, v, causal, positions_q=None, positions_k=None):
             mask = (
                 positions_q[:, None, :, None] >= positions_k[:, None, None, :]
             )
+    if segments_q is not None:
+        seg = (
+            segments_q[:, None, :, None] == segments_k[:, None, None, :]
+        )
+        mask = seg if mask is None else (mask & seg)
+    if mask is not None:
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum(

@@ -232,7 +232,12 @@ def _pipeline_runner(tcfg: TrainConfig):
     GPipe pipeline; embed/head stay outside (dp/tp-sharded, replicated over
     pp)."""
 
-    def runner(blocks, x, positions, cfg):
+    def runner(blocks, x, positions, cfg, segments=None):
+        if segments is not None:
+            raise ValueError(
+                "packed segment_ids are not supported through the GPipe "
+                "pipeline; train packed batches with pp_stages=1"
+            )
         return pipelined_blocks(
             blocks, x, positions, cfg, tcfg.pp_stages, tcfg.microbatches
         )

@@ -1,0 +1,190 @@
+"""Packed-sequence training: data.pack_examples + segment-aware attention.
+
+Golden property: a packed row's logits at each segment's positions equal
+the unpacked per-sequence forward — segments are invisible to each other.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorframes_tpu.data import lm_split_packed, pack_examples
+from tensorframes_tpu.models import transformer as tfm
+
+
+CFG = tfm.TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=64, max_seq=32, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init(jax.random.PRNGKey(0), CFG)
+
+
+def test_pack_examples_layout():
+    toks, segs, pos = pack_examples(
+        [np.arange(1, 6), np.arange(10, 13), np.arange(20, 24)], 8
+    )
+    np.testing.assert_array_equal(toks[0], [1, 2, 3, 4, 5, 10, 11, 12])
+    np.testing.assert_array_equal(segs[0], [1, 1, 1, 1, 1, 2, 2, 2])
+    np.testing.assert_array_equal(pos[0], [0, 1, 2, 3, 4, 0, 1, 2])
+    # second row: remaining example + padding
+    np.testing.assert_array_equal(toks[1, :4], [20, 21, 22, 23])
+    assert segs[1, 4:].sum() == 0  # padding is segment 0
+
+
+def test_pack_splits_overlong_examples():
+    toks, segs, _ = pack_examples([np.arange(20)], 8)
+    assert toks.shape[1] == 8
+    # 20 tokens -> chunks of 8, 8, 4: all content preserved in order
+    flat = toks[segs > 0]
+    np.testing.assert_array_equal(np.sort(flat), np.arange(20))
+
+
+def test_lm_split_packed_masks_boundaries():
+    toks, segs, pos = pack_examples([np.arange(1, 6), np.arange(10, 13)], 8)
+    _, tgt, s_, p_ = lm_split_packed(toks, segs, pos)
+    # the last token of segment 1 must NOT target segment 2's first token
+    assert tgt[0, 4] == -1
+    assert tgt[0, 3] == 5  # within-segment next token
+
+
+def test_packed_forward_matches_unpacked(params):
+    rng = np.random.RandomState(0)
+    seq_a = rng.randint(1, 64, 9)
+    seq_b = rng.randint(1, 64, 6)
+    toks, segs, pos = pack_examples([seq_a, seq_b], 16)
+    assert toks.shape[0] == 1  # both fit one row
+    packed = tfm.apply(
+        params, jnp.asarray(toks), CFG,
+        positions=jnp.asarray(pos), segment_ids=jnp.asarray(segs),
+    )
+    la = tfm.apply(params, jnp.asarray(seq_a)[None], CFG)
+    lb = tfm.apply(params, jnp.asarray(seq_b)[None], CFG)
+    np.testing.assert_allclose(
+        np.asarray(packed[0, :9]), np.asarray(la[0]), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(packed[0, 9:15]), np.asarray(lb[0]), atol=1e-5
+    )
+
+
+def test_segments_are_isolated(params):
+    rng = np.random.RandomState(1)
+    toks, segs, pos = pack_examples(
+        [rng.randint(1, 64, 8), rng.randint(1, 64, 8)], 16
+    )
+    out1 = tfm.apply(
+        params, jnp.asarray(toks), CFG,
+        positions=jnp.asarray(pos), segment_ids=jnp.asarray(segs),
+    )
+    toks2 = toks.copy()
+    toks2[0, 8:] = (toks2[0, 8:] + 7) % 64  # rewrite segment 2 entirely
+    out2 = tfm.apply(
+        params, jnp.asarray(toks2), CFG,
+        positions=jnp.asarray(pos), segment_ids=jnp.asarray(segs),
+    )
+    np.testing.assert_allclose(  # segment 1 logits unmoved
+        np.asarray(out1[0, :8]), np.asarray(out2[0, :8]), atol=1e-6
+    )
+    assert not np.allclose(np.asarray(out1[0, 8:]), np.asarray(out2[0, 8:]))
+
+
+def test_packed_loss_and_grads(params):
+    rng = np.random.RandomState(2)
+    toks, segs, pos = pack_examples(
+        [rng.randint(1, 64, n) for n in (9, 5, 12, 7)], 16
+    )
+    inp, tgt, s_, p_ = lm_split_packed(toks, segs, pos)
+    loss, grads = jax.value_and_grad(tfm.loss_fn)(
+        params, jnp.asarray(inp), jnp.asarray(tgt), CFG,
+        positions=jnp.asarray(p_), segment_ids=jnp.asarray(s_),
+    )
+    assert np.isfinite(float(loss))
+    assert all(
+        np.all(np.isfinite(np.asarray(g)))
+        for g in jax.tree_util.tree_leaves(grads)
+    )
+
+
+def test_packed_rejects_kernel_impls(params):
+    import dataclasses
+
+    toks, segs, pos = pack_examples([np.arange(1, 9)], 8)
+    for impl in ("flash", "ring", "ring_flash"):
+        cfg = dataclasses.replace(CFG, attn_impl=impl)
+        with pytest.raises(ValueError, match="segment_ids"):
+            tfm.apply(
+                params, jnp.asarray(toks), cfg,
+                positions=jnp.asarray(pos), segment_ids=jnp.asarray(segs),
+            )
+
+
+def test_packed_auto_resolves_to_full(params):
+    import dataclasses
+
+    toks, segs, pos = pack_examples([np.arange(1, 9)], 8)
+    cfg = dataclasses.replace(CFG, attn_impl="auto", flash_min_len=4)
+    out = tfm.apply(  # would pick flash by length; segments force full
+        params, jnp.asarray(toks), cfg,
+        positions=jnp.asarray(pos), segment_ids=jnp.asarray(segs),
+    )
+    assert out.shape == (1, 8, 64)
+
+
+def test_packed_moe_routes(params):
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        max_seq=16, dtype=jnp.float32, moe_experts=4,
+    )
+    p = tfm.init(jax.random.PRNGKey(3), cfg)
+    toks, segs, pos = pack_examples([np.arange(1, 9), np.arange(20, 28)], 16)
+    logits, aux = tfm.apply(
+        p, jnp.asarray(toks), cfg,
+        positions=jnp.asarray(pos), segment_ids=jnp.asarray(segs),
+        return_aux=True,
+    )
+    assert np.all(np.isfinite(np.asarray(logits))) and float(aux) > 0
+
+
+def test_pad_tokens_do_not_claim_moe_capacity():
+    """Packed padding (segment 0) must neither occupy expert capacity
+    slots nor move the load-balance statistics (review r3)."""
+    from tensorframes_tpu.models import moe
+
+    rng = np.random.RandomState(4)
+    probs = jnp.asarray(
+        np.exp(rng.randn(1, 8, 4)).astype(np.float32)
+    )
+    probs = probs / probs.sum(-1, keepdims=True)
+    valid = jnp.asarray([[True] * 5 + [False] * 3])
+    disp, comb, aux = moe.gate(probs, 2, 3, valid)
+    d = np.asarray(disp)
+    assert d[0, 5:].sum() == 0  # pad rows dispatch nothing
+    assert np.asarray(comb)[0, 5:].sum() == 0
+    # aux equals the stats over ONLY the real tokens
+    _, _, aux_real = moe.gate(probs[:, :5], 2, 3)
+    np.testing.assert_allclose(float(aux), float(aux_real), rtol=1e-6)
+    # and real tokens keep full access to capacity: slot count for the
+    # valid prefix matches an unpadded run at the same capacity
+    d_real = np.asarray(moe.gate(probs[:, :5], 2, 3)[0])
+    np.testing.assert_array_equal(d[0, :5], d_real[0])
+
+
+def test_packing_scales_linearly():
+    import time
+
+    rng = np.random.RandomState(0)
+    from tensorframes_tpu.data import pack_examples
+
+    ex = [rng.randint(1, 100, rng.randint(5, 120)) for _ in range(20_000)]
+    t0 = time.perf_counter()
+    toks, segs, _ = pack_examples(ex, 128)
+    dt = time.perf_counter() - t0
+    assert dt < 10.0, f"packing 20k examples took {dt:.1f}s"
+    # density sanity: first-fit should fill rows well past half
+    fill = (segs > 0).mean()
+    assert fill > 0.8, fill

@@ -32,7 +32,13 @@ import numpy as np
 
 from .frame import TensorFrame
 
-__all__ = ["FrameLoader", "lm_split"]
+__all__ = [
+    "FrameLoader",
+    "lm_split",
+    "lm_split_packed",
+    "pack_examples",
+    "packed_frame",
+]
 
 
 @dataclasses.dataclass
@@ -155,7 +161,8 @@ def pack_examples(
     pad_id: int = 0,
 ):
     """Greedy first-fit packing of variable-length token sequences into
-    fixed [N, seq_len] rows — no per-example padding waste, the standard
+    fixed [N, seq_len] rows (best-fit: each piece goes to the open row
+    with the least sufficient space) — no per-example padding waste, the standard
     LM pretraining input shape (static shapes for XLA; the attention mask
     keeps segments independent — ``transformer.apply(segment_ids=...)``).
 
@@ -174,9 +181,9 @@ def pack_examples(
         ex = np.asarray(ex).ravel()
         for i in range(0, len(ex), seq_len):
             pieces.append(ex[i : i + seq_len])
-    # first-fit with rows BUCKETED by remaining space: placing a piece is
-    # an O(seq_len) bucket scan instead of a scan over all open rows, so
-    # packing stays linear in corpus size (review r3)
+    # BEST-fit with rows bucketed by remaining space: placing a piece is
+    # an O(seq_len) bucket scan (smallest sufficient space wins) instead
+    # of a scan over all open rows — linear in corpus size (review r3)
     rows: List[List[np.ndarray]] = []
     space: List[int] = []
     by_space: Dict[int, List[int]] = {}
@@ -214,14 +221,39 @@ def lm_split_packed(tokens, segment_ids, positions):
     """Packed [N, L] arrays -> (inputs, targets, segs, pos) for the
     next-token objective: the target at position i is token i+1 ONLY when
     both belong to the same (non-padding) segment; everything else is -1
-    (ignored by ``transformer.cross_entropy``)."""
-    tokens = np.asarray(tokens)
-    segment_ids = np.asarray(segment_ids)
-    positions = np.asarray(positions)
+    (ignored by ``transformer.cross_entropy``).  Works on numpy or device
+    arrays (device inputs stay on device — ``train.fit(packed=True)``
+    calls this per batch)."""
+    import jax.numpy as jnp
+
+    xp = jnp if not isinstance(tokens, np.ndarray) else np
     inp = tokens[:, :-1]
-    tgt = tokens[:, 1:].astype(np.int32).copy()
+    tgt = tokens[:, 1:]
     same = (segment_ids[:, 1:] == segment_ids[:, :-1]) & (
         segment_ids[:, :-1] > 0
     )
-    tgt[~same] = -1
+    tgt = xp.where(same, tgt, -1)
     return inp, tgt, segment_ids[:, :-1], positions[:, :-1]
+
+
+def packed_frame(
+    examples: Sequence[np.ndarray],
+    seq_len: int,
+    num_blocks: int = 1,
+    pad_id: int = 0,
+):
+    """Pack a variable-length corpus straight into an analyzed
+    :class:`~.frame.TensorFrame` with ``tokens``/``segments``/``positions``
+    columns of width ``seq_len + 1`` (one extra position so the
+    next-token split yields ``seq_len``-wide training rows), ready for
+    ``FrameLoader`` + ``train.fit(packed=True)``."""
+    from .analyze import analyze
+    from .frame import TensorFrame
+
+    toks, segs, pos = pack_examples(examples, seq_len + 1, pad_id)
+    return analyze(
+        TensorFrame.from_arrays(
+            {"tokens": toks, "segments": segs, "positions": pos},
+            num_blocks=num_blocks,
+        )
+    )

@@ -168,7 +168,7 @@ def _route(bp, y: jnp.ndarray, cfg, segments=None):
     return yg, probs, dispatch, combine, aux, cap
 
 
-def routing_stats(bp, y: jnp.ndarray, cfg) -> dict:
+def routing_stats(bp, y: jnp.ndarray, cfg, segments=None) -> dict:
     """Routing diagnostics for one batch of activations — the MoE
     observability surface (``observability.py`` spans time verbs; this
     inspects *where tokens go*).  Runs the SAME ``_route`` as the layer.
@@ -179,10 +179,12 @@ def routing_stats(bp, y: jnp.ndarray, cfg) -> dict:
     * ``drop_fraction``: assignments lost to capacity;
     * ``aux``: the load-balance loss this routing would contribute.
     """
-    yg, probs, dispatch, _, aux, cap = _route(bp, y, cfg)
+    yg, probs, dispatch, _, aux, cap = _route(bp, y, cfg, segments)
     G, S, _ = yg.shape
     assigned = float(jnp.sum(dispatch))
-    total = G * S * cfg.moe_top_k
+    total = (
+        int(jnp.sum(segments > 0)) if segments is not None else G * S
+    ) * cfg.moe_top_k
     load = jnp.sum(dispatch, axis=(0, 1, 3)) / max(assigned, 1.0)
     return {
         "load": np.asarray(load, dtype=np.float64),
@@ -193,24 +195,30 @@ def routing_stats(bp, y: jnp.ndarray, cfg) -> dict:
     }
 
 
-def layer_routing_stats(params, tokens: jnp.ndarray, cfg, layer: int = 0) -> dict:
+def layer_routing_stats(
+    params, tokens: jnp.ndarray, cfg, layer: int = 0,
+    positions=None, segments=None,
+) -> dict:
     """``routing_stats`` on the ACTUAL MLP input of block ``layer`` for a
     token batch: runs the forward through blocks ``0..layer-1`` and block
     ``layer``'s attention half, then probes its router — the activations
-    are exactly what training routed, not an embedding-space proxy."""
+    are exactly what training routed, not an embedding-space proxy.
+    Pass ``positions``/``segments`` for packed batches so the replay (and
+    the pad exclusion) matches packed training."""
     from . import transformer as tfm
 
     B, L = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
     x = tfm.embed_lookup(params["embed"], tokens, cfg.dtype)
     blocks = params["blocks"]
     for i in range(layer):
         bp_i = jax.tree_util.tree_map(lambda a: a[i], blocks)
-        x, _ = tfm._block(bp_i, x, positions, cfg)
+        x, _ = tfm._block(bp_i, x, positions, cfg, None, segments)
     bp = jax.tree_util.tree_map(lambda a: a[layer], blocks)
-    x, _ = tfm._attn_residual(bp, x, positions, cfg)
+    x, _ = tfm._attn_residual(bp, x, positions, cfg, None, segments)
     y = tfm._rms_norm(x, bp["ln2"])
-    return routing_stats(bp, y, cfg)
+    return routing_stats(bp, y, cfg, segments)
 
 
 def moe_mlp(

@@ -476,6 +476,13 @@ def apply(
             f"(packed sequences need the explicit mask); use "
             f"attn_impl='full' or 'auto'"
         )
+    if segment_ids is not None and positions is None:
+        raise ValueError(
+            "segment_ids without restart positions: RoPE would rotate "
+            "later segments from a continuous arange and logits would "
+            "silently differ from the per-example forward — pass the "
+            "positions from data.pack_examples/lm_split_packed"
+        )
     if cfg.attn_impl == "auto":
         # kernel choice by mesh + length (VERDICT r2 weak #2).  Under an
         # ambient mesh with a real sp axis the sequence arrives sharded, so

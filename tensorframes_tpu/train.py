@@ -44,6 +44,12 @@ class TrainConfig:
     grad_clip: float = 1.0
     pp_stages: int = 1  # pipeline stages (must divide n_layers)
     microbatches: int = 1  # GPipe microbatches (must divide batch)
+    # "constant" | "cosine" (linear warmup to learning_rate, cosine decay
+    # to lr_min over total_steps — the standard LM pretraining schedule)
+    schedule: str = "constant"
+    warmup_steps: int = 0
+    total_steps: int = 0  # required for schedule="cosine"
+    lr_min: float = 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -267,11 +273,38 @@ def loss_pipelined(params, tokens, targets, cfg, tcfg):
 # ---------------------------------------------------------------------------
 
 
+def make_schedule(tcfg: TrainConfig):
+    """Learning-rate schedule from the config: a float (constant) or an
+    optax schedule fn (warmup + cosine)."""
+    if tcfg.schedule == "constant":
+        if tcfg.warmup_steps:
+            return optax.linear_schedule(
+                0.0, tcfg.learning_rate, tcfg.warmup_steps
+            )
+        return tcfg.learning_rate
+    if tcfg.schedule == "cosine":
+        if tcfg.total_steps <= 0:
+            raise ValueError(
+                "schedule='cosine' needs total_steps > 0 (the horizon the "
+                "cosine decays over)"
+            )
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=tcfg.learning_rate,
+            warmup_steps=tcfg.warmup_steps,
+            decay_steps=tcfg.total_steps,
+            end_value=tcfg.lr_min,
+        )
+    raise ValueError(
+        f"unknown schedule {tcfg.schedule!r}; use 'constant' or 'cosine'"
+    )
+
+
 def make_optimizer(tcfg: TrainConfig) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(tcfg.grad_clip),
         optax.adamw(
-            learning_rate=tcfg.learning_rate,
+            learning_rate=make_schedule(tcfg),
             b1=tcfg.b1,
             b2=tcfg.b2,
             eps=tcfg.eps,
@@ -289,6 +322,7 @@ def fit(
     params: Optional[Params] = None,
     rng: int = 0,
     column: str = "tokens",
+    packed: bool = False,
 ) -> Tuple[Params, Any, list]:
     """Train the flagship LM straight from the data plane.
 
@@ -299,14 +333,18 @@ def fit(
     to training.  Run under ``jax.set_mesh(...)`` to shard; works unsharded
     on one chip.
 
+    ``packed=True``: batches must carry ``tokens``/``segments``/
+    ``positions`` columns (``data.packed_frame`` builds such a frame) and
+    each step trains with segment-aware attention.
+
     Returns ``(params, opt_state, losses)``.
     """
-    from .data import lm_split
+    from .data import lm_split, lm_split_packed
 
     if params is None:
         params = tfm.init(jax.random.PRNGKey(rng), cfg)
     params = tfm.shard_params(params)
-    train_step, tx = make_train_step(cfg, tcfg)
+    train_step, tx = make_train_step(cfg, tcfg, packed=packed)
     opt_state = tx.init(params)
     losses = []
     it = loader.forever() if hasattr(loader, "forever") else iter(loader)
@@ -319,25 +357,60 @@ def fit(
                 f"pass a FrameLoader (cycles epochs via .forever()) or an "
                 f"iterable with at least `steps` batches"
             ) from None
-        tokens, targets = lm_split(batch, column)
-        params, opt_state, loss = train_step(
-            params, opt_state, tokens, targets
-        )
+        if packed:
+            tokens, targets, segs, pos = lm_split_packed(
+                batch["tokens"], batch["segments"], batch["positions"]
+            )
+            params, opt_state, loss = train_step(
+                params, opt_state, tokens, targets, segs, pos
+            )
+        else:
+            tokens, targets = lm_split(batch, column)
+            params, opt_state, loss = train_step(
+                params, opt_state, tokens, targets
+            )
         losses.append(loss)  # device scalars: don't sync the step loop
     return params, opt_state, [float(l) for l in losses]
 
 
-def make_train_step(cfg: TransformerConfig, tcfg: TrainConfig):
+def make_train_step(
+    cfg: TransformerConfig, tcfg: TrainConfig, packed: bool = False
+):
     """Returns ``(train_step, tx)``; ``train_step(params, opt_state,
     tokens, targets) -> (params, opt_state, loss)``, jitted.  Shard params
     (``transformer.shard_params``) and batch before calling; GSPMD lays out
-    grads and optimizer state to match."""
+    grads and optimizer state to match.
+
+    ``packed=True``: the step takes two extra arguments ``(segments,
+    positions)`` (``data.lm_split_packed``) and trains with segment-aware
+    attention (single-stage only — the pipeline schedule rejects packed
+    batches)."""
     tx = make_optimizer(tcfg)
 
-    def loss_fn(params, tokens, targets):
+    def loss_fn(params, tokens, targets, segments=None, positions=None):
         if tcfg.pp_stages > 1:
             return loss_pipelined(params, tokens, targets, cfg, tcfg)
-        return tfm.loss_fn(params, tokens, targets, cfg)
+        return tfm.loss_fn(
+            params, tokens, targets, cfg,
+            positions=positions, segment_ids=segments,
+        )
+
+    if packed:
+        if tcfg.pp_stages > 1:
+            raise ValueError(
+                "packed training is single-stage; set pp_stages=1"
+            )
+
+        @jax.jit
+        def train_step(params, opt_state, tokens, targets, segments, positions):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, targets, segments, positions
+            )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return train_step, tx
 
     @jax.jit
     def train_step(params, opt_state, tokens, targets):

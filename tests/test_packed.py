@@ -188,3 +188,31 @@ def test_packing_scales_linearly():
     # density sanity: first-fit should fill rows well past half
     fill = (segs > 0).mean()
     assert fill > 0.8, fill
+
+
+def test_segments_without_positions_rejected(params):
+    toks, segs, _ = pack_examples([np.arange(1, 9)], 8)
+    with pytest.raises(ValueError, match="restart positions"):
+        tfm.apply(
+            params, jnp.asarray(toks), CFG, segment_ids=jnp.asarray(segs)
+        )
+
+
+def test_packed_routing_stats_exclude_pads():
+    from tensorframes_tpu.models import moe
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        max_seq=16, dtype=jnp.float32, moe_experts=4,
+    )
+    p = tfm.init(jax.random.PRNGKey(5), cfg)
+    toks, segs, pos = pack_examples([np.arange(1, 7)], 16)  # 10 pad slots
+    stats = moe.layer_routing_stats(
+        p, jnp.asarray(toks), cfg,
+        positions=jnp.asarray(pos), segments=jnp.asarray(segs),
+    )
+    # drop fraction is over REAL tokens only: with 6 tokens, 4 experts,
+    # ample capacity there are no drops; unpadded-aware accounting would
+    # report nonsense (negative or >1 values)
+    assert stats["drop_fraction"] == pytest.approx(0.0, abs=1e-6)
+    np.testing.assert_allclose(stats["load"].sum(), 1.0, rtol=1e-6)

@@ -226,3 +226,41 @@ def test_trained_weights_score_better_through_verbs():
         .column("nll").data
     ).mean()
     assert nll_t < nll_f * 0.7, (nll_t, nll_f)
+
+
+def test_fit_packed_corpus():
+    """Variable-length corpus -> packed_frame -> FrameLoader ->
+    fit(packed=True): the whole packed pipeline learns."""
+    from tensorframes_tpu.data import FrameLoader, packed_frame
+    from tensorframes_tpu.models import transformer as tfm
+
+    rng = np.random.RandomState(0)
+    corpus = [
+        (rng.randint(0, 32, 1) + np.arange(n)) % 32
+        for n in rng.randint(5, 20, 80)
+    ]
+    frame = packed_frame(corpus, seq_len=16, num_blocks=4)
+    assert frame.column("tokens").data.shape[1] == 17
+    cfg = tfm.TransformerConfig(
+        vocab_size=32, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, max_seq=16, dtype=jnp.float32,
+    )
+    loader = FrameLoader(frame, batch_size=8, shuffle=True, seed=0)
+    params, _, losses = train.fit(
+        loader, cfg, train.TrainConfig(learning_rate=1e-2),
+        steps=20, packed=True,
+    )
+    assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
+
+
+def test_make_train_step_packed_rejects_pipeline():
+    from tensorframes_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=32, d_model=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_seq=16,
+    )
+    with pytest.raises(ValueError, match="single-stage"):
+        train.make_train_step(
+            cfg, train.TrainConfig(pp_stages=2), packed=True
+        )

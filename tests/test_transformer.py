@@ -438,3 +438,34 @@ def test_checkpoint_restore_onto_different_mesh(tmp_path, setup):
         )
     assert restored["step"] == 7
     ck.close()
+
+
+def test_lr_schedules(setup):
+    cfg, params, toks, tgts = setup
+    tcfg = train.TrainConfig(
+        learning_rate=1e-2, schedule="cosine", warmup_steps=2, total_steps=10
+    )
+    sched = train.make_schedule(tcfg)
+    assert float(sched(0)) == 0.0  # warmup from zero
+    assert float(sched(2)) == pytest.approx(1e-2)  # peak after warmup
+    assert float(sched(10)) == pytest.approx(0.0, abs=1e-8)  # decayed out
+    step, tx = train.make_train_step(cfg, tcfg)
+    opt = tx.init(params)
+    p1, opt, loss = step(params, opt, toks, tgts)
+    # step 0 has lr 0: params must be UNCHANGED (weight decay rides the lr)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(p1)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    p2, opt, loss = step(p1, opt, toks, tgts)  # step 1: lr > 0 moves them
+    moved = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)
+        )
+    )
+    assert moved
+    with pytest.raises(ValueError, match="total_steps"):
+        train.make_schedule(train.TrainConfig(schedule="cosine"))
+    with pytest.raises(ValueError, match="unknown schedule"):
+        train.make_schedule(train.TrainConfig(schedule="poly"))

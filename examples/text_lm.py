@@ -1,0 +1,61 @@
+"""The whole text journey: raw strings -> BPE -> packed frame -> train ->
+generate -> text.
+
+Every stage is this framework's own: `text.BPETokenizer` (byte-level BPE),
+`data.packed_frame` (best-fit packing + segment-aware attention),
+`tfs.FrameLoader` -> `train.fit`, and `decode.generate` (KV cache +
+sampling).  Run: ``python examples/text_lm.py``.
+"""
+
+import _bootstrap  # noqa: F401  (checkout path shim; examples/ is on sys.path when run directly)
+
+import jax.numpy as jnp
+import numpy as np
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import train
+from tensorframes_tpu.data import packed_frame
+from tensorframes_tpu.models import decode
+from tensorframes_tpu.models.transformer import TransformerConfig
+from tensorframes_tpu.text import BPETokenizer
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the lazy dog sleeps while the quick fox runs",
+    "a quick fox and a lazy dog share the yard",
+    "the dog watches the fox jump over the fence",
+] * 8
+
+
+def main(steps: int = 60, seq_len: int = 24, vocab: int = 320) -> None:
+    tok = BPETokenizer.train(CORPUS, vocab)
+    print(f"BPE: {tok.vocab_size} tokens, "
+          f"{len(tok.encode(CORPUS[0]))} ids for {len(CORPUS[0])} chars")
+
+    seqs = [np.asarray(tok.encode(s), np.int32) for s in CORPUS]
+    frame = packed_frame(seqs, seq_len=seq_len, num_blocks=4)
+    fill = float((np.asarray(frame.column("segments").data) > 0).mean())
+    print(f"packed {len(seqs)} lines into "
+          f"{frame.num_rows} rows (fill {fill:.0%})")
+
+    cfg = TransformerConfig(
+        vocab_size=tok.vocab_size, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=4, d_ff=128, max_seq=seq_len, dtype=jnp.float32,
+    )
+    loader = tfs.FrameLoader(frame, batch_size=8, shuffle=True, seed=0)
+    params, _, losses = train.fit(
+        loader, cfg,
+        train.TrainConfig(learning_rate=1e-2, schedule="cosine",
+                          warmup_steps=5, total_steps=steps),
+        steps=steps, packed=True,
+    )
+    print(f"loss: {losses[0]:.3f} -> {losses[-1]:.3f}")
+
+    prompt = "the quick"
+    ids = jnp.asarray([tok.encode(prompt)], jnp.int32)
+    out = decode.generate(params, ids, cfg, max_new_tokens=12)
+    print(f"'{prompt}' -> {tok.decode(np.asarray(out)[0].tolist())!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,145 @@
+"""Byte-level BPE tokenizer: the text -> tokens front door.
+
+Net-new vs the reference (whose data plane starts at numeric/binary
+columns); a complete LM framework needs the full journey raw text ->
+tokens -> TensorFrame -> train -> generate -> text.  Design choices:
+
+* **byte-level base vocabulary** (ids 0-255): any UTF-8 string encodes
+  without an unknown token, and ``decode(encode(s)) == s`` exactly;
+* classic BPE training — iteratively merge the most frequent adjacent
+  pair — on a whitespace-delimited word histogram (merges never cross
+  word boundaries, the standard tractability cut);
+* deterministic: ties break lexicographically, so identical corpora give
+  identical vocabularies on every run/host (a broadcast-free analog of
+  the reference's program-broadcast determinism);
+* pure host-side Python/NumPy: tokenization is data-plane preprocessing
+  (``data.pack_examples`` / ``FrameLoader`` take it from there).
+
+The implementation is the textbook algorithm, sized for corpora that fit
+in memory; it is a reference tokenizer, not a Rust-speed production one.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+__all__ = ["BPETokenizer"]
+
+
+class BPETokenizer:
+    """Byte-level BPE.  ``train`` builds merges; ``encode``/``decode``
+    round-trip any UTF-8 text exactly."""
+
+    def __init__(self, merges: Sequence[Tuple[int, int]] = ()):
+        self.merges: List[Tuple[int, int]] = [tuple(m) for m in merges]
+        # merged pair -> new token id (ids 256.. in merge order)
+        self._ranks: Dict[Tuple[int, int], int] = {
+            tuple(m): 256 + i for i, m in enumerate(self.merges)
+        }
+        # token id -> raw bytes
+        self._bytes: List[bytes] = [bytes([b]) for b in range(256)]
+        for a, b in self.merges:
+            self._bytes.append(self._bytes[a] + self._bytes[b])
+
+    # -- training -----------------------------------------------------------
+
+    @classmethod
+    def train(cls, texts: Iterable[str], vocab_size: int) -> "BPETokenizer":
+        """Learn ``vocab_size - 256`` merges from the corpus."""
+        if vocab_size < 256:
+            raise ValueError("byte-level vocab needs vocab_size >= 256")
+        words = Counter()
+        for t in texts:
+            for w in t.split(" "):
+                words[w.encode("utf-8")] += 1
+        # each distinct word as a tuple of token ids, with its count
+        seqs: Dict[Tuple[int, ...], int] = {
+            tuple(w): c for w, c in words.items() if w
+        }
+        merges: List[Tuple[int, int]] = []
+        tok = cls(())
+        while 256 + len(merges) < vocab_size:
+            pairs = Counter()
+            for seq, c in seqs.items():
+                for pair in zip(seq, seq[1:]):
+                    pairs[pair] += c
+            if not pairs:
+                break
+            # deterministic: max count, then lexicographically smallest
+            best = min(
+                (p for p in pairs),
+                key=lambda p: (-pairs[p], p),
+            )
+            if pairs[best] < 2:
+                break  # nothing repeats: further merges are noise
+            new_id = 256 + len(merges)
+            merges.append(best)
+            merged: Dict[Tuple[int, ...], int] = {}
+            for seq, c in seqs.items():
+                out: List[int] = []
+                i = 0
+                while i < len(seq):
+                    if (
+                        i + 1 < len(seq)
+                        and (seq[i], seq[i + 1]) == best
+                    ):
+                        out.append(new_id)
+                        i += 2
+                    else:
+                        out.append(seq[i])
+                        i += 1
+                merged[tuple(out)] = merged.get(tuple(out), 0) + c
+            seqs = merged
+        return cls(merges)
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges)
+
+    # -- encode / decode ----------------------------------------------------
+
+    def _encode_word(self, word: bytes) -> List[int]:
+        seq = list(word)
+        while len(seq) > 1:
+            # lowest-rank (earliest-learned) applicable merge first — the
+            # canonical BPE application order
+            ranked = [
+                (self._ranks[p], i)
+                for i, p in enumerate(zip(seq, seq[1:]))
+                if p in self._ranks
+            ]
+            if not ranked:
+                break
+            rank, i = min(ranked)
+            seq[i : i + 2] = [rank]
+        return seq
+
+    def encode(self, text: str) -> List[int]:
+        """UTF-8 text -> token ids.  Spaces delimit words and encode as
+        their own byte token (32), mirroring training's word split."""
+        ids: List[int] = []
+        first = True
+        for w in text.split(" "):
+            if not first:
+                ids.append(32)
+            first = False
+            if w:
+                ids.extend(self._encode_word(w.encode("utf-8")))
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = b"".join(self._bytes[int(i)] for i in ids)
+        return data.decode("utf-8", errors="replace")
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"merges": self.merges}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            return cls(json.load(f)["merges"])

@@ -68,3 +68,10 @@ def test_moe_train_example(capsys):
     mod["main"](n_rows=16, seq=8, steps=6)
     out = capsys.readouterr().out
     assert "expert load" in out and "4-expert top-2 MoE" in out
+
+
+def test_text_lm_example(capsys):
+    mod = _run("text_lm.py")
+    mod["main"](steps=15, seq_len=16, vocab=300)
+    out = capsys.readouterr().out
+    assert "BPE:" in out and "'the quick' ->" in out

@@ -1,0 +1,68 @@
+"""Byte-level BPE tokenizer (text.py)."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu.text import BPETokenizer
+
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the quick brown fox is quick and the dog is lazy",
+    "pack my box with five dozen liquor jugs",
+] * 4
+
+
+def test_roundtrip_exact():
+    tok = BPETokenizer.train(CORPUS, 300)
+    for s in CORPUS + ["völlig neu! 日本語 🙂", "", "  spaces  "]:
+        assert tok.decode(tok.encode(s)) == s
+
+
+def test_training_compresses():
+    tok = BPETokenizer.train(CORPUS, 320)
+    s = CORPUS[0]
+    ids = tok.encode(s)
+    assert len(ids) < len(s.encode("utf-8"))  # merges actually bite
+    assert max(ids) >= 256  # merged tokens in use
+    assert tok.vocab_size <= 320
+
+
+def test_deterministic():
+    a = BPETokenizer.train(CORPUS, 300).merges
+    b = BPETokenizer.train(list(CORPUS), 300).merges
+    assert a == b
+
+
+def test_untrained_is_raw_bytes():
+    tok = BPETokenizer()
+    assert tok.encode("ab c") == [97, 98, 32, 99]
+    assert tok.vocab_size == 256
+
+
+def test_save_load(tmp_path):
+    tok = BPETokenizer.train(CORPUS, 280)
+    p = str(tmp_path / "bpe.json")
+    tok.save(p)
+    tok2 = BPETokenizer.load(p)
+    assert tok2.merges == tok.merges
+    s = "the quick dog"
+    assert tok2.encode(s) == tok.encode(s)
+
+
+def test_vocab_floor_validated():
+    with pytest.raises(ValueError, match=">= 256"):
+        BPETokenizer.train(CORPUS, 100)
+
+
+def test_text_to_training_pipeline():
+    """The full front door: text -> BPE -> packed frame columns."""
+    from tensorframes_tpu.data import pack_examples
+
+    tok = BPETokenizer.train(CORPUS, 300)
+    seqs = [np.asarray(tok.encode(s)) for s in CORPUS]
+    toks, segs, pos = pack_examples(seqs, 32)
+    assert toks.max() < tok.vocab_size
+    # decode a packed segment back to its source text
+    row0 = toks[0][segs[0] == 1]
+    assert tok.decode(row0.tolist()) in CORPUS[0]

@@ -28,11 +28,13 @@ from .dtypes import ScalarType, by_name as scalar_type, supported_types
 from .frame import TensorFrame
 from .ops import (
     Executor,
+    Pipeline,
     ValidationError,
     aggregate,
     group_by,
     map_blocks,
     map_rows,
+    pipeline,
     reduce_blocks,
     reduce_rows,
 )
@@ -83,6 +85,8 @@ __all__ = [
     "map_blocks",
     "map_blocks_trimmed",
     "map_rows",
+    "pipeline",
+    "Pipeline",
     "reduce_blocks",
     "reduce_rows",
     "Program",

@@ -9,6 +9,7 @@ from .engine import (
     reduce_blocks,
     reduce_rows,
 )
+from .pipeline import Pipeline, pipeline
 from .validation import ValidationError
 
 __all__ = [
@@ -17,6 +18,8 @@ __all__ = [
     "group_by",
     "map_blocks",
     "map_rows",
+    "Pipeline",
+    "pipeline",
     "reduce_blocks",
     "reduce_rows",
     "ValidationError",

@@ -1,0 +1,666 @@
+"""Fused verb pipelines: a chain of verbs compiled into ONE XLA dispatch.
+
+The per-verb engine (``engine.py``) dispatches each verb separately: a chained
+``map_blocks_trimmed -> reduce_blocks`` step — the body of every iterative
+driver (logreg, kmeans) — pays one dispatch per verb plus a host readback of
+the reduced scalars per step.  That is exactly the per-call overhead the
+reference measures in its perf suite
+(``/root/reference/src/test/scala/org/tensorframes/perf/PerformanceSuite.scala:14-26``)
+and works around by fusing compute + pre-aggregation into a single TF graph
+(``/root/reference/src/main/python/tensorframes_snippets/kmeans_demo.py:101-168``).
+The TPU-native answer is stronger than graph fusion: *the whole verb chain is
+one jit trace*, so XLA fuses across verb boundaries, intermediates never leave
+HBM, and an iterative driver can run its entire loop on device
+(``lax.scan``) with parameters carried between steps — one dispatch and one
+readback for K steps, instead of 2K dispatches and K scalar syncs.
+
+Usage::
+
+    pipe = (tfs.pipeline(frame)
+            .map_blocks(grad_prog, trim=True)     # block -> 1-row partials
+            .reduce_blocks(sum_prog)              # cross-block sum
+            .then(sgd_update))                    # traced post-processing
+    row  = pipe.run()                             # ONE dispatch; device dict
+    out  = pipe.collect()                         # run + host materialise
+
+    # iterative driver: K steps in ONE dispatch, params stay on device
+    finals, hist = pipe.iterate(50, carry={"w": "w", "b": "b"},
+                                collect=("loss",))
+
+Semantics match the eager verbs exactly (parity-tested in
+``tests/test_pipeline.py``); the differences are deliberate and validated at
+build time:
+
+* host-only (binary/string) and ragged columns cannot flow *through* a fused
+  trace — a program referencing one is rejected with a pointer at the eager
+  verbs (host_stage decode belongs outside a fused chain by construction);
+  untouched host columns of the source frame are re-attached to map-terminal
+  outputs on the host side, where row identity is preserved.
+* ``aggregate`` is not fusable (its group structure is data-dependent); use
+  the eager verb.
+
+This executor is single-device by design — the fused executable targets one
+chip; ``parallel.MeshExecutor`` distributes the *eager* verbs over a mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes, observability
+from ..frame import TensorFrame, is_device_array
+from ..program import Program
+from ..schema import ColumnInfo, Schema
+from ..shape import Shape, UNKNOWN
+from . import validation
+from .engine import _DEFAULT
+from .validation import ValidationError
+
+
+@dataclasses.dataclass(frozen=True)
+class _Stage:
+    kind: str  # map_blocks | map_rows | reduce_blocks | reduce_rows | then
+    program: Optional[Program] = None
+    trim: bool = False
+    mode: str = "tree"
+    fn: Optional[Callable] = None
+    # build-time bookkeeping
+    reduced_bases: Tuple[str, ...] = ()
+
+
+class _SchemaView:
+    """Duck-typed stand-in for a TensorFrame in the validation helpers (they
+    only touch ``.schema``)."""
+
+    def __init__(self, infos: Mapping[str, ColumnInfo]):
+        self.schema = Schema(list(infos.values()))
+
+
+def _block_info(name: str, st, cell_shape) -> ColumnInfo:
+    return ColumnInfo(name, st, Shape(cell_shape).prepend(UNKNOWN))
+
+
+class Pipeline:
+    """A lazy verb chain over one frame; built by :func:`pipeline`.
+
+    Builder methods return a NEW Pipeline (the receiver stays valid), so
+    chains can fork.  Compilation happens at the first ``run``/``collect``/
+    ``iterate`` and is cached on the terminal Pipeline object.
+    """
+
+    def __init__(
+        self,
+        frame: TensorFrame,
+        stages: Tuple[_Stage, ...] = (),
+        visible: Optional[Dict[str, ColumnInfo]] = None,
+        from_source: Optional[Dict[str, bool]] = None,
+        row_stage: bool = False,
+    ):
+        self._frame = frame
+        self._stages = stages
+        if visible is None:
+            visible = {}
+            from_source = {}
+            for c in frame.columns:
+                if c.info.scalar_type.device_ok and not c.is_ragged:
+                    visible[c.info.name] = c.info
+                    from_source[c.info.name] = True
+        self._visible = visible
+        self._from_source = from_source or {}
+        self._row_stage = row_stage  # terminal produces a row, not a frame
+        self._compiled = None
+        self._iter_compiled: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------ builders --
+
+    def _require_frame_stage(self, verb: str) -> None:
+        if self._row_stage:
+            raise ValidationError(
+                f"pipeline.{verb}: the chain already ended in a row-producing "
+                f"stage (reduce/then); only then/run/collect/iterate may "
+                f"follow."
+            )
+
+    def _check_inputs(
+        self, program: Program, verb: str
+    ) -> Dict[str, ColumnInfo]:
+        infos: Dict[str, ColumnInfo] = {}
+        source_schema = self._frame.schema
+        for n in program.input_names:
+            col = program.column_for_input(n)
+            if col in self._visible:
+                infos[n] = self._visible[col]
+                continue
+            if col in source_schema:
+                ci = source_schema[col]
+                fcol = self._frame.column(col)
+                if not ci.scalar_type.device_ok or fcol.is_ragged:
+                    why = (
+                        "is host-only (binary/string)"
+                        if not ci.scalar_type.device_ok
+                        else "is ragged/un-analyzed"
+                    )
+                    raise ValidationError(
+                        f"pipeline.{verb}: column {col!r} {why} and cannot "
+                        f"flow through a fused device trace. Use the eager "
+                        f"verb (tfs.{verb}) with host_stage/analyze for "
+                        f"this column."
+                    )
+                raise ValidationError(
+                    f"pipeline.{verb}: column {col!r} was dropped by an "
+                    f"earlier trim stage (trim=True replaces the block with "
+                    f"the program outputs only). Available here: "
+                    f"{sorted(self._visible)}."
+                )
+            raise ValidationError(
+                f"pipeline.{verb}: program input {n!r} requests column "
+                f"{col!r}, which is not available at this point in the "
+                f"chain. Available: {sorted(self._visible)}."
+            )
+        return infos
+
+    def _analyzed_outputs(
+        self, program: Program, infos: Mapping[str, ColumnInfo], cell: bool
+    ) -> Dict[str, ColumnInfo]:
+        """Shape-infer a map stage's outputs to keep schema tracking exact."""
+        specs = {}
+        for n, ci in infos.items():
+            st = dtypes.coerce(ci.scalar_type)
+            shape = tuple(ci.cell_shape) if cell else (UNKNOWN,) + tuple(
+                ci.cell_shape
+            )
+            specs[n] = (st, Shape(shape))
+        outs: Dict[str, ColumnInfo] = {}
+        for s in program.analyze(specs):
+            if s.is_output:
+                block_shape = (
+                    s.shape.prepend(UNKNOWN) if cell else s.shape
+                )
+                if not cell and block_shape.rank == 0:
+                    raise ValidationError(
+                        f"pipeline.map_blocks: output {s.name!r} is a scalar; "
+                        f"block outputs need a lead row axis."
+                    )
+                outs[s.name] = ColumnInfo(s.name, s.scalar_type, block_shape)
+        return outs
+
+    def map_blocks(self, fn, trim: bool = False, **kw) -> "Pipeline":
+        """Append a block-level map (``tfs.map_blocks``; trim=True for
+        ``map_blocks_trimmed``)."""
+        self._require_frame_stage("map_blocks")
+        program = Program.wrap(fn, **kw)
+        infos = self._check_inputs(program, "map_blocks")
+        outs = self._analyzed_outputs(program, infos, cell=False)
+        visible = dict(outs) if trim else {**self._visible, **outs}
+        from_source = (
+            {k: False for k in outs}
+            if trim
+            else {**self._from_source, **{k: False for k in outs}}
+        )
+        return Pipeline(
+            self._frame,
+            self._stages + (_Stage("map_blocks", program, trim=trim),),
+            visible,
+            from_source,
+        )
+
+    def map_blocks_trimmed(self, fn, **kw) -> "Pipeline":
+        return self.map_blocks(fn, trim=True, **kw)
+
+    def map_rows(self, fn, **kw) -> "Pipeline":
+        """Append a row-level map (``tfs.map_rows``, vmapped in the trace)."""
+        self._require_frame_stage("map_rows")
+        program = Program.wrap(fn, **kw)
+        infos = self._check_inputs(program, "map_rows")
+        outs = self._analyzed_outputs(program, infos, cell=True)
+        visible = {**self._visible, **outs}
+        from_source = {**self._from_source, **{k: False for k in outs}}
+        return Pipeline(
+            self._frame,
+            self._stages + (_Stage("map_rows", program),),
+            visible,
+            from_source,
+        )
+
+    def reduce_blocks(self, fn, **kw) -> "Pipeline":
+        """Append the terminal block reduction (``tfs.reduce_blocks``)."""
+        self._require_frame_stage("reduce_blocks")
+        if self._frame.num_rows == 0:
+            raise ValidationError(
+                "pipeline.reduce_blocks: cannot reduce an empty frame (no "
+                "identity element is available for an arbitrary block "
+                "program)"
+            )
+        program = Program.wrap(fn, **kw)
+        view = _SchemaView(self._visible)
+        reduced = validation.check_reduce_blocks(
+            program, view, verb="pipeline.reduce_blocks"
+        )
+        bases = tuple(sorted(reduced))
+        probe = max(self._frame.block_sizes) or 1
+        summaries = program.analyze(
+            {
+                f"{b}_input": (
+                    dtypes.coerce(reduced[b].scalar_type),
+                    (probe,) + tuple(reduced[b].cell_shape),
+                )
+                for b in bases
+            }
+        )
+        validation.check_reduce_blocks_outputs(
+            reduced, summaries, verb="pipeline.reduce_blocks"
+        )
+        return Pipeline(
+            self._frame,
+            self._stages
+            + (_Stage("reduce_blocks", program, reduced_bases=bases),),
+            self._visible,
+            self._from_source,
+            row_stage=True,
+        )
+
+    def reduce_rows(self, fn, mode: str = "tree", **kw) -> "Pipeline":
+        """Append the terminal pairwise reduction (``tfs.reduce_rows``)."""
+        self._require_frame_stage("reduce_rows")
+        if self._frame.num_rows == 0:
+            raise ValidationError(
+                "pipeline.reduce_rows: cannot reduce an empty frame (no "
+                "identity element is available for an arbitrary pairwise "
+                "program)"
+            )
+        if mode not in ("tree", "sequential"):
+            raise ValidationError(
+                f"pipeline.reduce_rows: unknown mode {mode!r}; use 'tree' or "
+                f"'sequential'"
+            )
+        program = Program.wrap(fn, **kw)
+        view = _SchemaView(self._visible)
+        reduced = validation.check_reduce_rows(program, view)
+        bases = tuple(sorted(reduced))
+        summaries = program.analyze(
+            {
+                f"{b}_{i}": (
+                    dtypes.coerce(reduced[b].scalar_type),
+                    tuple(reduced[b].cell_shape),
+                )
+                for b in bases
+                for i in (1, 2)
+            }
+        )
+        validation.check_reduce_rows_outputs(reduced, summaries)
+        return Pipeline(
+            self._frame,
+            self._stages
+            + (_Stage("reduce_rows", program, mode=mode, reduced_bases=bases),),
+            self._visible,
+            self._from_source,
+            row_stage=True,
+        )
+
+    def then(self, fn: Callable) -> "Pipeline":
+        """Append traced post-processing of the reduced row.
+
+        ``fn(row, params)`` receives the reduced outputs (name -> array) and
+        the union of all stage-program params (name -> value) and returns a
+        dict of named outputs — the place for parameter updates and derived
+        scalars, fused into the same dispatch."""
+        if not self._row_stage:
+            raise ValidationError(
+                "pipeline.then: requires a reduce stage first (then() "
+                "post-processes the reduced row)."
+            )
+        seen: Dict[str, int] = {}
+        for i, st in enumerate(self._stages):
+            if st.program is not None:
+                for pname in st.program.param_names:
+                    if pname in seen and seen[pname] != i:
+                        raise ValidationError(
+                            f"pipeline.then: param name {pname!r} exists on "
+                            f"multiple stages; rename one to disambiguate."
+                        )
+                    seen[pname] = i
+        return Pipeline(
+            self._frame,
+            self._stages + (_Stage("then", fn=fn),),
+            self._visible,
+            self._from_source,
+            row_stage=True,
+        )
+
+    # --------------------------------------------------------------- trace --
+
+    def _needed_source_cols(self) -> List[str]:
+        """Source columns the trace must receive: every referenced source
+        column, plus — for map-terminal chains — every still-visible source
+        column (they pass through into the output frame)."""
+        needed = set()
+        for st in self._stages:
+            if st.program is None:
+                continue
+            if st.kind in ("map_blocks", "map_rows"):
+                refs = [
+                    st.program.column_for_input(n)
+                    for n in st.program.input_names
+                ]
+            else:
+                refs = list(st.reduced_bases)
+            needed.update(refs)
+        if not self._row_stage:
+            needed.update(
+                k for k, src in self._from_source.items() if src
+            )
+        # keep only true source columns (later stages may reference derived)
+        src_names = {
+            c.info.name
+            for c in self._frame.columns
+            if c.info.scalar_type.device_ok and not c.is_ragged
+        }
+        return sorted(needed & src_names)
+
+    def _body(self, cols: Dict[str, Any], params_list: List[Dict]) -> Any:
+        """The traced chain: cols are full source columns; returns either the
+        final row dict or the list of per-block column dicts."""
+        frame = self._frame
+        offsets = frame.offsets
+        src_schema = frame.schema
+        blocks: List[Dict[str, Any]] = []
+        for i in range(frame.num_blocks):
+            lo, hi = offsets[i], offsets[i + 1]
+            if hi == lo:
+                continue  # empty-partition guard (engine parity)
+            blk = {}
+            for name, arr in cols.items():
+                st = dtypes.coerce(src_schema[name].scalar_type)
+                a = arr[lo:hi]
+                if a.dtype != st.np_dtype:
+                    a = a.astype(st.np_dtype)
+                blk[name] = a
+            blocks.append(blk)
+
+        row: Optional[Dict[str, Any]] = None
+        for st, params in zip(self._stages, params_list):
+            if st.kind == "map_blocks":
+                new_blocks = []
+                for blk in blocks:
+                    n_rows = len(next(iter(blk.values())))
+                    inputs = {
+                        n: blk[st.program.column_for_input(n)]
+                        for n in st.program.input_names
+                    }
+                    outs = st.program.call(inputs, params)
+                    if not st.trim:
+                        for name, v in outs.items():
+                            if v.ndim == 0 or v.shape[0] != n_rows:
+                                raise ValidationError(
+                                    f"pipeline.map_blocks: output {name!r} "
+                                    f"has shape {v.shape} but the block has "
+                                    f"{n_rows} rows; use trim=True to change "
+                                    f"the row count."
+                                )
+                        nb = {
+                            **{
+                                k: v for k, v in blk.items() if k not in outs
+                            },
+                            **outs,
+                        }
+                    else:
+                        counts = {
+                            v.shape[0] if v.ndim else None
+                            for v in outs.values()
+                        }
+                        if len(counts) != 1 or None in counts:
+                            raise ValidationError(
+                                f"pipeline.map_blocks_trimmed: outputs "
+                                f"disagree on row count: "
+                                f"{ {k: v.shape for k, v in outs.items()} }"
+                            )
+                        nb = dict(outs)
+                    new_blocks.append(nb)
+                blocks = new_blocks
+            elif st.kind == "map_rows":
+                program = st.program
+                new_blocks = []
+                for blk in blocks:
+                    inputs = {
+                        n: blk[program.column_for_input(n)]
+                        for n in program.input_names
+                    }
+                    outs = jax.vmap(
+                        lambda ins, p=params, pr=program: pr.call(ins, p),
+                        in_axes=(0,),
+                    )(inputs)
+                    new_blocks.append(
+                        {
+                            **{
+                                k: v for k, v in blk.items() if k not in outs
+                            },
+                            **outs,
+                        }
+                    )
+                blocks = new_blocks
+            elif st.kind == "reduce_blocks":
+                program, bases = st.program, list(st.reduced_bases)
+                partials = [
+                    program.call(
+                        {f"{b}_input": blk[b] for b in bases}, params
+                    )
+                    for blk in blocks
+                ]
+                if len(partials) == 1:
+                    row = partials[0]
+                else:
+                    stacked = {
+                        f"{b}_input": jnp.stack([p[b] for p in partials])
+                        for b in bases
+                    }
+                    row = program.call(stacked, params)
+            elif st.kind == "reduce_rows":
+                program, bases = st.program, list(st.reduced_bases)
+                pairfn = _DEFAULT._pair_call(program, bases)
+                fold = (
+                    _DEFAULT._tree_fold
+                    if st.mode == "tree"
+                    else _DEFAULT._seq_fold
+                )
+                partials = [
+                    fold(pairfn, {b: blk[b] for b in bases}, params)
+                    for blk in blocks
+                ]
+                if len(partials) == 1:
+                    row = partials[0]
+                else:
+                    stacked = {
+                        b: jnp.stack([p[b] for p in partials]) for b in bases
+                    }
+                    row = fold(pairfn, stacked, params)
+            elif st.kind == "then":
+                merged: Dict[str, Any] = {}
+                for stg, p in zip(self._stages, params_list):
+                    if stg.program is not None:
+                        merged.update(p)
+                out = st.fn(row, merged)
+                if not isinstance(out, Mapping):
+                    raise ValidationError(
+                        "pipeline.then: fn must return a dict of named "
+                        f"outputs, got {type(out).__name__}"
+                    )
+                row = {k: jnp.asarray(v) for k, v in out.items()}
+            else:  # pragma: no cover
+                raise AssertionError(st.kind)
+        return row if self._row_stage else blocks
+
+    def _params_list(self) -> List[Dict[str, Any]]:
+        return [
+            dict(st.program._params) if st.program is not None else {}
+            for st in self._stages
+        ]
+
+    # ----------------------------------------------------------- execution --
+
+    def run(self):
+        """Compile (once) and dispatch the fused chain — ONE jit call.
+
+        Returns device-resident results: a dict of arrays for row-terminal
+        chains, a TensorFrame with device columns for map-terminal chains.
+        No host sync happens here; materialise with ``collect()`` /
+        ``np.asarray`` when the values are needed."""
+        if not self._stages:
+            raise ValidationError("pipeline.run: empty pipeline (no stages)")
+        with observability.verb_span(
+            "pipeline", self._frame.num_rows, self._frame.num_blocks
+        ) as span:
+            if self._compiled is None:
+                self._compiled = jax.jit(
+                    lambda cols, params_list: self._body(cols, params_list)
+                )
+            cols = self._entry_cols()
+            span.mark("validate")
+            out = self._compiled(cols, self._params_list())
+            span.mark("dispatch")
+            if self._row_stage:
+                return out
+            frame = TensorFrame.from_blocks(out)
+            # host-only / ragged source columns pass through unchanged when
+            # the chain preserves row identity (no trim stage)
+            if not any(s.trim for s in self._stages):
+                extra = [
+                    c
+                    for c in self._frame.columns
+                    if c.info.name not in frame.column_names
+                    and c.info.name not in self._visible
+                ]
+                if extra:
+                    frame = TensorFrame(
+                        list(frame.columns) + extra, frame.offsets
+                    )
+            return frame
+
+    def _entry_cols(self) -> Dict[str, Any]:
+        cols = {}
+        for name in self._needed_source_cols():
+            c = self._frame.column(name)
+            data = c.data
+            if not is_device_array(data):
+                st = dtypes.coerce(c.info.scalar_type)
+                data = np.asarray(data)
+                if data.dtype != st.np_dtype:
+                    data = data.astype(st.np_dtype)
+            cols[name] = data
+        return cols
+
+    def collect(self):
+        """``run()`` + host materialisation (the one sync)."""
+        out = self.run()
+        if self._row_stage:
+            host = jax.device_get(out)
+            return {k: np.asarray(v) for k, v in host.items()}
+        return out.uncache()
+
+    def iterate(
+        self,
+        num_steps: int,
+        carry: Mapping[str, str],
+        collect: Sequence[str] = (),
+    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Run the chain ``num_steps`` times in ONE dispatch (``lax.scan``),
+        feeding outputs back into stage params between steps.
+
+        ``carry``: output name -> param name.  After each step, the named
+        output becomes the new value of every stage param with that name —
+        the on-device form of the ``update_params`` iterative-driver
+        contract (the reference re-broadcasts a re-built graph per step,
+        ``kmeans_demo.py:68-80``; the eager engine updates params per
+        dispatch; here the update never leaves the device).
+
+        ``collect``: output names whose per-step values are stacked and
+        returned as history (e.g. the loss curve).
+
+        Returns ``(final_params, history)`` — ``final_params`` maps each
+        carried param name to its final device value (the stage programs are
+        also updated in place, so ``run()``/``iterate()`` continue from the
+        new state); ``history`` maps each collected name to a ``[num_steps,
+        ...]`` device array."""
+        if not self._row_stage:
+            raise ValidationError(
+                "pipeline.iterate: requires a row-terminal chain "
+                "(reduce/then) so step outputs can feed back into params."
+            )
+        if not carry:
+            raise ValidationError(
+                "pipeline.iterate: carry={} would loop without feedback; "
+                "use run() in a host loop instead."
+            )
+        targets: List[Tuple[int, str, str]] = []  # (stage idx, param, output)
+        for out_name, param_name in carry.items():
+            hits = [
+                i
+                for i, st in enumerate(self._stages)
+                if st.program is not None
+                and param_name in st.program.param_names
+            ]
+            if not hits:
+                raise ValidationError(
+                    f"pipeline.iterate: carry target param {param_name!r} "
+                    f"does not exist on any stage program."
+                )
+            for i in hits:
+                targets.append((i, param_name, out_name))
+
+        key = (num_steps, tuple(sorted(carry.items())), tuple(collect))
+        if key not in self._iter_compiled:
+
+            def loop(cols, params_list):
+                def step(pl, _):
+                    row = self._body(cols, pl)
+                    for name in list(carry) + list(collect):
+                        if name not in row:
+                            raise ValidationError(
+                                f"pipeline.iterate: {name!r} is not an "
+                                f"output of the chain; outputs are "
+                                f"{sorted(row)}."
+                            )
+                    new_pl = [dict(p) for p in pl]
+                    for i, pname, oname in targets:
+                        old = new_pl[i][pname]
+                        new = row[oname]
+                        if new.shape != old.shape:
+                            raise ValidationError(
+                                f"pipeline.iterate: carried output "
+                                f"{oname!r} has shape {new.shape} but param "
+                                f"{pname!r} has shape {old.shape}; shapes "
+                                f"must match for a stable loop carry."
+                            )
+                        new_pl[i][pname] = new.astype(old.dtype)
+                    return new_pl, {k: row[k] for k in collect}
+
+                final_pl, hist = jax.lax.scan(
+                    step, params_list, None, length=num_steps
+                )
+                finals = {}
+                for i, pname, _ in targets:
+                    finals[pname] = final_pl[i][pname]
+                return finals, hist
+
+            self._iter_compiled[key] = jax.jit(loop)
+
+        with observability.verb_span(
+            "pipeline.iterate", self._frame.num_rows, self._frame.num_blocks
+        ) as span:
+            cols = self._entry_cols()
+            span.mark("validate")
+            finals, hist = self._iter_compiled[key](cols, self._params_list())
+            span.mark("dispatch")
+            # resume contract: stage programs pick up the final params
+            for i, pname, _ in targets:
+                self._stages[i].program.update_params(**{pname: finals[pname]})
+            return finals, hist
+
+
+def pipeline(frame: TensorFrame) -> Pipeline:
+    """Start a fused verb chain over ``frame`` (see :class:`Pipeline`)."""
+    return Pipeline(frame)

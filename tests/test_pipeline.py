@@ -1,0 +1,260 @@
+"""Fused pipeline parity vs the eager verbs, plus the fusion contracts.
+
+Every pipeline result must match the corresponding eager verb chain exactly
+(same programs, same frame) — the pipeline is an execution strategy, not a
+semantics change.  Reference for the fusion pattern being replaced:
+``kmeans_demo.py:101-168`` (in-graph pre-aggregation to cut per-call
+overhead)."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.ops.pipeline import pipeline
+from tensorframes_tpu.ops.validation import ValidationError
+
+
+def _frame(n=40, d=4, blocks=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return tfs.analyze(
+        tfs.TensorFrame.from_arrays(
+            {
+                "x": rng.rand(n, d).astype(np.float32),
+                "y": rng.rand(n).astype(np.float32),
+            },
+            num_blocks=blocks,
+        )
+    )
+
+
+def test_map_blocks_parity():
+    fr = _frame()
+    fn = lambda x: {"z": x * 2.0 + 1.0}
+    eager = tfs.map_blocks(fn, fr)
+    fused = pipeline(fr).map_blocks(fn).run()
+    np.testing.assert_allclose(
+        np.asarray(fused.column("z").data), np.asarray(eager.column("z").data)
+    )
+    # passthrough columns survive
+    assert set(fused.column_names) == set(eager.column_names)
+    np.testing.assert_allclose(
+        np.asarray(fused.column("y").data), np.asarray(fr.column("y").data)
+    )
+
+
+def test_chained_maps_parity():
+    fr = _frame()
+    f1 = lambda x: {"z": x.sum(axis=1)}
+    f2 = lambda z, y: {"w": z + y}
+    eager = tfs.map_blocks(f2, tfs.map_blocks(f1, fr))
+    fused = pipeline(fr).map_blocks(f1).map_blocks(f2).run()
+    np.testing.assert_allclose(
+        np.asarray(fused.column("w").data),
+        np.asarray(eager.column("w").data),
+        rtol=1e-6,
+    )
+
+
+def test_map_rows_parity():
+    fr = _frame()
+    fn = lambda x: {"n2": (x * x).sum()}
+    eager = tfs.map_rows(fn, fr)
+    fused = pipeline(fr).map_rows(fn).run()
+    np.testing.assert_allclose(
+        np.asarray(fused.column("n2").data),
+        np.asarray(eager.column("n2").data),
+        rtol=1e-6,
+    )
+
+
+def test_reduce_blocks_parity():
+    fr = _frame()
+    fn = lambda x_input: {"x": x_input.sum(0)}
+    eager = tfs.reduce_blocks(fn, fr)
+    fused = pipeline(fr).reduce_blocks(fn).collect()
+    np.testing.assert_allclose(fused["x"], eager["x"], rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["tree", "sequential"])
+def test_reduce_rows_parity(mode):
+    fr = _frame()
+    fn = lambda y_1, y_2: {"y": y_1 + y_2}
+    eager = tfs.reduce_rows(fn, fr, mode=mode)
+    fused = pipeline(fr).reduce_rows(fn, mode=mode).collect()
+    np.testing.assert_allclose(fused["y"], eager["y"], rtol=1e-6)
+
+
+def test_trim_then_reduce_parity():
+    """The iterative-driver shape: per-block partials then cross-block sum."""
+    fr = _frame()
+    grad = lambda x: {"g": x.sum(0, keepdims=True)}
+    summ = lambda g_input: {"g": g_input.sum(0)}
+    eager = tfs.reduce_blocks(summ, tfs.map_blocks(grad, fr, trim=True))
+    fused = (
+        pipeline(fr).map_blocks(grad, trim=True).reduce_blocks(summ).collect()
+    )
+    np.testing.assert_allclose(fused["g"], eager["g"], rtol=1e-6)
+
+
+def test_then_postprocess():
+    fr = _frame()
+    fused = (
+        pipeline(fr)
+        .reduce_blocks(lambda y_input: {"y": y_input.sum(0)})
+        .then(lambda row, params: {"mean": row["y"] / fr.num_rows})
+        .collect()
+    )
+    np.testing.assert_allclose(
+        fused["mean"], np.asarray(fr.column("y").data).mean(), rtol=1e-6
+    )
+
+
+def test_single_dispatch_no_retrace():
+    """The chain traces once; repeated run() calls reuse the executable."""
+    fr = _frame()
+    traces = []
+
+    def fn(x):
+        traces.append(1)
+        return {"z": x + 1.0}
+
+    pipe = pipeline(fr).map_blocks(fn)
+    pipe.run()
+    n_first = len(traces)
+    assert n_first >= 1
+    pipe.run()
+    pipe.run()
+    assert len(traces) == n_first  # no retrace on later dispatches
+
+
+def test_iterate_matches_host_loop():
+    """iterate(K) == K eager steps with update_params between them."""
+    from tensorframes_tpu.program import Program
+
+    rng = np.random.RandomState(0)
+    n, d = 64, 3
+    feats = rng.rand(n, d).astype(np.float32)
+    ys = rng.rand(n).astype(np.float32)
+    fr = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"x": feats, "y": ys}, num_blocks=2)
+    )
+    lr = 0.1
+
+    def make_grad():
+        def fn(x, y, w):
+            import jax.numpy as jnp
+
+            err = x @ w - y
+            return {
+                "gw": (x.T @ err)[None, :],
+                "loss": (err * err).sum()[None],
+            }
+
+        return Program.wrap(fn, params={"w": np.zeros(d, np.float32)})
+
+    summ = lambda gw_input, loss_input: {
+        "gw": gw_input.sum(0),
+        "loss": loss_input.sum(0),
+    }
+
+    def update(row, params):
+        return {
+            "w": params["w"] - lr * row["gw"] / n,
+            "loss": row["loss"] / n,
+        }
+
+    # fused: K steps in one dispatch
+    gprog = make_grad()
+    pipe = (
+        pipeline(fr)
+        .map_blocks(gprog, trim=True)
+        .reduce_blocks(summ)
+        .then(update)
+    )
+    K = 5
+    finals, hist = pipe.iterate(K, carry={"w": "w"}, collect=("loss",))
+    assert np.asarray(hist["loss"]).shape == (K,)
+
+    # eager loop with the same programs
+    gprog2 = make_grad()
+    w = np.zeros(d, np.float32)
+    losses = []
+    for _ in range(K):
+        partials = tfs.map_blocks(gprog2, fr, trim=True)
+        row = tfs.reduce_blocks(summ, partials)
+        losses.append(float(row["loss"]) / n)
+        w = w - lr * np.asarray(row["gw"]) / n
+        gprog2.update_params(w=w)
+
+    np.testing.assert_allclose(np.asarray(finals["w"]), w, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(hist["loss"]), losses, rtol=1e-5)
+    # resume contract: the stage program carries the final params
+    np.testing.assert_allclose(
+        np.asarray(gprog.params["w"]), w, rtol=1e-5
+    )
+
+
+def test_logreg_fused_matches_eager():
+    from tensorframes_tpu.models import logistic_regression as lr
+
+    rng = np.random.RandomState(1)
+    n, d = 96, 5
+    feats = rng.rand(n, d).astype(np.float32)
+    labels = (feats @ rng.randn(d) > 0).astype(np.float32)
+    fr = tfs.analyze(
+        tfs.TensorFrame.from_arrays(
+            {"features": feats, "label": labels}, num_blocks=3
+        )
+    )
+    params_e, losses_e = lr.fit(fr, num_iters=6, lr=0.5)
+    params_f, losses_f = lr.fit_fused(fr, num_iters=6, lr=0.5)
+    np.testing.assert_allclose(
+        np.asarray(params_f["w"]), np.asarray(params_e["w"]), rtol=1e-4
+    )
+    np.testing.assert_allclose(losses_f, losses_e, rtol=1e-4)
+
+
+def test_errors():
+    fr = _frame()
+    # stage after terminal
+    p = pipeline(fr).reduce_blocks(lambda x_input: {"x": x_input.sum(0)})
+    with pytest.raises(ValidationError, match="row-producing"):
+        p.map_blocks(lambda x: {"z": x})
+    # unknown column
+    with pytest.raises(ValidationError, match="not available"):
+        pipeline(fr).map_blocks(lambda nope: {"z": nope})
+    # then without reduce
+    with pytest.raises(ValidationError, match="reduce stage first"):
+        pipeline(fr).then(lambda row, params: row)
+    # non-trim row-count violation is caught at trace time
+    bad = pipeline(fr).map_blocks(lambda x: {"z": x.sum(0, keepdims=True)})
+    with pytest.raises(ValidationError, match="trim"):
+        bad.run()
+    # iterate on a frame-terminal chain
+    with pytest.raises(ValidationError, match="row-terminal"):
+        pipeline(fr).map_blocks(lambda x: {"z": x}).iterate(
+            2, carry={"z": "w"}
+        )
+
+
+def test_host_column_rejected_but_passthrough_ok():
+    fr = tfs.TensorFrame.from_arrays(
+        {
+            "x": np.arange(6.0, dtype=np.float32),
+            "blob": [b"a", b"bb", b"ccc", b"d", b"ee", b"f"],
+        },
+        num_blocks=2,
+    )
+    fr = tfs.analyze(fr)
+    with pytest.raises(ValidationError, match="host-only"):
+        pipeline(fr).map_blocks(lambda blob: {"z": blob})
+    out = pipeline(fr).map_blocks(lambda x: {"z": x + 1}).run()
+    assert "blob" in out.column_names  # host passthrough re-attached
+    assert [bytes(c) for c in out.column("blob").cells()] == [
+        b"a",
+        b"bb",
+        b"ccc",
+        b"d",
+        b"ee",
+        b"f",
+    ]

@@ -371,8 +371,9 @@ class Pipeline:
         blocks: List[Dict[str, Any]] = []
         for i in range(frame.num_blocks):
             lo, hi = offsets[i], offsets[i + 1]
-            if hi == lo:
-                continue  # empty-partition guard (engine parity)
+            # empty blocks flow through map stages (eager parity: map verbs
+            # emit one output block per input block, empty included); the
+            # reduce stages skip them below, like the engine's guards
             blk = {}
             for name, arr in cols.items():
                 st = dtypes.coerce(src_schema[name].scalar_type)
@@ -450,7 +451,13 @@ class Pipeline:
                         {f"{b}_input": blk[b] for b in bases}, params
                     )
                     for blk in blocks
+                    if next(iter(blk.values())).shape[0] > 0
                 ]
+                if not partials:
+                    raise ValidationError(
+                        "pipeline.reduce_blocks: every block is empty at "
+                        "the reduce stage; nothing to reduce."
+                    )
                 if len(partials) == 1:
                     row = partials[0]
                 else:
@@ -470,7 +477,13 @@ class Pipeline:
                 partials = [
                     fold(pairfn, {b: blk[b] for b in bases}, params)
                     for blk in blocks
+                    if next(iter(blk.values())).shape[0] > 0
                 ]
+                if not partials:
+                    raise ValidationError(
+                        "pipeline.reduce_rows: every block is empty at "
+                        "the reduce stage; nothing to reduce."
+                    )
                 if len(partials) == 1:
                     row = partials[0]
                 else:
@@ -628,6 +641,13 @@ class Pipeline:
                     for i, pname, oname in targets:
                         old = new_pl[i][pname]
                         new = row[oname]
+                        if not hasattr(old, "shape"):
+                            raise ValidationError(
+                                f"pipeline.iterate: param {pname!r} is a "
+                                f"pytree, not a single array; only "
+                                f"leaf-array params can be carried — bind "
+                                f"the leaves as separate params."
+                            )
                         if new.shape != old.shape:
                             raise ValidationError(
                                 f"pipeline.iterate: carried output "

@@ -469,3 +469,80 @@ def test_lr_schedules(setup):
         train.make_schedule(train.TrainConfig(schedule="cosine"))
     with pytest.raises(ValueError, match="unknown schedule"):
         train.make_schedule(train.TrainConfig(schedule="poly"))
+
+
+# -- round 4: remat policies + chunked cross-entropy -------------------------
+
+
+@pytest.mark.parametrize("policy", ["full", "dots", "attn", "selective"])
+def test_remat_policies_match_none(setup, policy):
+    """Every remat policy is an execution strategy: loss AND grads must
+    match remat_policy='none' to fp tolerance."""
+    cfg, params, toks, tgts = setup
+    cfg_p = dataclasses.replace(cfg, remat_policy=policy)
+    l0, g0 = jax.value_and_grad(
+        lambda p: tfm.loss_fn(p, toks, tgts, cfg)
+    )(params)
+    l1, g1 = jax.value_and_grad(
+        lambda p: tfm.loss_fn(p, toks, tgts, cfg_p)
+    )(params)
+    assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+
+
+def test_remat_policy_validation():
+    with pytest.raises(ValueError, match="remat_policy"):
+        small_cfg(remat_policy="bogus")
+    # 'attn' targets the full-attention core only; flash/ring reject it
+    cfg = small_cfg(remat_policy="attn", attn_impl="ring")
+    toks = jnp.zeros((2, 16), jnp.int32)
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="remat_policy='attn'"):
+        tfm.apply(params, toks, cfg)
+
+
+def test_chunked_cross_entropy_matches_full(setup):
+    """cross_entropy_chunked == cross_entropy(hidden @ head) exactly, and
+    loss_fn(ce_chunk=...) matches the classic loss with matching grads."""
+    cfg, params, toks, tgts = setup
+    # direct function-level parity, with ignored (-1) targets in the mix
+    tgts_m = jnp.where(
+        jax.random.uniform(jax.random.PRNGKey(3), tgts.shape) < 0.2, -1, tgts
+    )
+    _, hidden = tfm.apply(params, toks, cfg, return_hidden=True)
+    logits = jnp.einsum(
+        "bld,dv->blv",
+        hidden,
+        params["lm_head"].astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    full = tfm.cross_entropy(logits, tgts_m)
+    for chunk in (4, 8, 16):
+        chunked = tfm.cross_entropy_chunked(
+            hidden, params["lm_head"], tgts_m, chunk, cfg.dtype
+        )
+        assert float(full) == pytest.approx(float(chunked), rel=1e-6)
+    with pytest.raises(ValueError, match="must divide"):
+        tfm.cross_entropy_chunked(
+            hidden, params["lm_head"], tgts_m, 7, cfg.dtype
+        )
+    # loss_fn-level parity incl. gradients
+    cfg_c = dataclasses.replace(cfg, ce_chunk=8)
+    l0, g0 = jax.value_and_grad(
+        lambda p: tfm.loss_fn(p, toks, tgts_m, cfg)
+    )(params)
+    l1, g1 = jax.value_and_grad(
+        lambda p: tfm.loss_fn(p, toks, tgts_m, cfg_c)
+    )(params)
+    assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )

@@ -30,6 +30,7 @@ the mesh/``shard_map`` executor with collective cross-shard reduction.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import jax
@@ -113,9 +114,16 @@ class Executor:
     single-cell results of the reduce verbs.
     """
 
-    # monoid aggregates may run as one device segment reduction; mesh
-    # executors override this off (the path is single-device by design)
+    # monoid aggregates may run as one device segment reduction; the mesh
+    # executor shards the same path over its data axis via _place_rows
     supports_segment_aggregate = True
+
+    def _place_rows(self, arr: jnp.ndarray) -> jnp.ndarray:
+        """Device placement for a row-axis array in the segment-aggregate
+        path.  The mesh executor overrides this to shard the rows over the
+        data axis, turning the device sort + segment reduction into a
+        GSPMD-distributed one (SURVEY P5 at mesh scale)."""
+        return jnp.asarray(arr)
 
     # ---------------------------------------------------------------- map --
 
@@ -724,46 +732,61 @@ class Executor:
     def _aggregate_segment(
         self, program: Program, grouped: GroupedFrame, reduced, bases, span
     ) -> Optional[TensorFrame]:
-        """Dense-key fast path (SURVEY P5's TPU equivalent): the whole keyed
+        """Device fast path (SURVEY P5's TPU equivalent): the whole keyed
         reduction runs ON DEVICE as one segmented reduction.
 
         Applies when the program is a recognized *monoid* per column —
         ``sum`` / ``min`` / ``max`` / ``prod`` straight over the block axis
-        (detected from the jaxpr, never guessed from probing) — and the
-        single grouping key is an integer column.  Then, instead of the
-        host ``np.unique``/argsort/gather shuffle replacement:
+        (detected from the jaxpr, never guessed from probing).  Keys may be
+        any number of int / bool / float scalar columns.  Then, instead of
+        the host ``np.unique``/argsort/gather shuffle replacement:
 
-        * device stable ``argsort`` of the keys, segment ids from the
-          sorted-key boundaries, ``jax.ops.segment_{sum,min,max,prod}``
-          over the reordered column — zero full-column host copies;
+        * ONE device ``lax.sort`` over all key columns (lexicographic,
+          stable) carrying a row-index operand — the multi-key analog of
+          a stable argsort; float keys are canonicalised first (-0.0 ->
+          +0.0, every NaN payload -> the NaN) so device grouping matches
+          ``np.unique``, and their segment boundaries compare *bit
+          patterns* so the canonical NaNs group together;
+        * segment ids from the sorted-key boundaries,
+          ``jax.ops.segment_{sum,min,max,prod}`` over the reordered
+          columns — zero full-column host copies, zero host sort;
         * the one host sync is a scalar readback of the group count;
           ``num_segments`` (static under jit) is padded to the next power
           of two so recompiles stay logarithmic in group count;
         * outputs (group keys + reduced cells) stay device-resident.
 
-        Returns None when not applicable (general programs keep the
-        bucketed/tree paths).  Mesh executors opt out via
-        ``supports_segment_aggregate = False`` — this path is single-device
-        by construction, and hijacking a dp-sharded aggregate onto one chip
-        would idle the mesh.  Reference: ``DebugRowOps.scala:601-695``
-        (UDAF merge), replaced here by a single XLA scatter-reduce."""
+        On a :class:`~tensorframes_tpu.parallel.MeshExecutor` the key and
+        data columns are sharded over the data axis (``_place_rows``), so
+        the sort, the scatter-reduce, and the compaction run as ONE
+        GSPMD-partitioned computation whose cross-shard exchanges ride the
+        ICI — the mesh-scale form of the reference's shuffle-grouped
+        aggregation (``DebugRowOps.scala:601-695``).
+
+        Returns None when not applicable — non-monoid programs, ragged or
+        host-only columns, and key dtypes that would not survive device
+        canonicalisation (int64/f64 with x64 off merge distinct groups)
+        keep the exact host-indexed paths."""
         if not getattr(self, "supports_segment_aggregate", True):
             return None
         frame = grouped.frame
-        if len(grouped.keys) != 1 or frame.num_rows == 0:
+        n = frame.num_rows
+        if n == 0 or n >= np.iinfo(np.int32).max:
             return None
-        kcol = frame.column(grouped.keys[0])
-        kst = kcol.info.scalar_type
-        # keys must survive device canonicalisation unchanged: with x64 off,
-        # int64 keys would silently truncate to int32 on device and merge
-        # distinct groups (the hazard frame.cache() documents) — those fall
-        # back to the host np.unique path, which is exact
-        if (
-            kcol.is_ragged
-            or np.dtype(kst.np_dtype).kind not in "iub"
-            or dtypes.coerce(kst) is not kst
-        ):
-            return None
+        kcols = []
+        for kname in grouped.keys:
+            kcol = frame.column(kname)
+            kst = kcol.info.scalar_type
+            # keys must survive device canonicalisation unchanged: with x64
+            # off, int64/f64 keys would silently truncate on device and
+            # merge distinct groups (the hazard frame.cache() documents) —
+            # those fall back to the host np.unique path, which is exact
+            if (
+                kcol.is_ragged
+                or np.dtype(kst.np_dtype).kind not in "iubf"
+                or dtypes.coerce(kst) is not kst
+            ):
+                return None
+            kcols.append(kcol)
         for b in bases:
             col = frame.column(b)
             if col.is_ragged or not col.info.scalar_type.device_ok:
@@ -772,34 +795,42 @@ class Executor:
         if monoids is None:
             return None
 
-        keys = jnp.asarray(kcol.data)
-        order = jnp.argsort(keys, stable=True)
-        sk = keys[order]
-        newseg = jnp.concatenate(
-            [jnp.ones((1,), bool), sk[1:] != sk[:-1]]
+        keys = tuple(
+            self._place_rows(jnp.asarray(kcol.data)) for kcol in kcols
         )
-        gid = jnp.cumsum(newseg.astype(jnp.int32)) - 1
-        num_groups = int(gid[-1]) + 1  # the one host sync (scalar)
+        iota = self._place_rows(jnp.arange(n, dtype=jnp.int32))
+        # stage 1 (one dispatch): canonicalise + lexicographic sort +
+        # segment-id build + group count
+        sk, order, gid, newseg, count = _segment_index(keys, iota)
+        num_groups = int(count)  # the one host sync (scalar)
         pad = 1 << (num_groups - 1).bit_length()
-        uniq = sk[newseg]  # eager boolean mask: stays on device
+        # stage 2 (one dispatch): compact the unique key rows; the static
+        # size is the power-of-two pad — like the reduce stage — so
+        # executables cache logarithmically in group count
+        uniqs = tuple(
+            u[:num_groups] for u in _segment_compact(sk, newseg, pad)
+        )
         span.mark("group_index_device")
 
         outs: Dict[str, Any] = {}
         for b in bases:
             st = dtypes.coerce(reduced[b].scalar_type)
-            col = jnp.asarray(frame.column(b).data).astype(st.np_dtype)
-            outs[b] = _segment_reduce(
-                col[order], gid, pad, monoids[b]
-            )[:num_groups]
+            col = self._place_rows(
+                jnp.asarray(frame.column(b).data).astype(st.np_dtype)
+            )
+            outs[b] = _segment_apply(col, order, gid, pad, monoids[b])[
+                :num_groups
+            ]
         span.mark("execute")
 
         cols: List[Column] = []
-        kinfo = ColumnInfo(
-            kcol.info.name,
-            kcol.info.scalar_type,
-            Shape(uniq.shape).with_lead(UNKNOWN),
-        )
-        cols.append(Column(kinfo, uniq))
+        for kcol, uniq in zip(kcols, uniqs):
+            kinfo = ColumnInfo(
+                kcol.info.name,
+                kcol.info.scalar_type,
+                Shape(uniq.shape).with_lead(UNKNOWN),
+            )
+            cols.append(Column(kinfo, uniq))
         for b in bases:
             arr = outs[b]
             st = dtypes.from_numpy(np.dtype(arr.dtype))
@@ -982,6 +1013,71 @@ def _segment_reduce(data, gid, num_segments: int, kind: str):
             static_argnames=("num_segments",),
         )
     return fn(data, gid, num_segments=num_segments)
+
+
+def _canonical_key(k):
+    """Float keys canonicalised so device grouping matches ``np.unique``:
+    -0.0 folds into +0.0 and every NaN payload becomes THE NaN (their
+    shared bit pattern then groups them in ``_boundary``)."""
+    if np.dtype(k.dtype).kind == "f":
+        # explicit where (not `k + 0.0`): XLA's algebraic simplifier
+        # rewrites x+0 to x, which would leave -0.0 bit patterns alive
+        k = jnp.where(k == 0, jnp.zeros((), k.dtype), k)
+        k = jnp.where(jnp.isnan(k), jnp.asarray(jnp.nan, k.dtype), k)
+    return k
+
+
+def _boundary(k):
+    """True where sorted key column changes value (float: bit compare, so
+    the canonical NaNs form one group)."""
+    if np.dtype(k.dtype).kind == "f":
+        ibits = {2: jnp.int16, 4: jnp.int32, 8: jnp.int64}[
+            np.dtype(k.dtype).itemsize
+        ]
+        b = jax.lax.bitcast_convert_type(k, ibits)
+        return b[1:] != b[:-1]
+    return k[1:] != k[:-1]
+
+
+@jax.jit
+def _segment_index(keys, iota):
+    """Aggregate fast-path stage 1, one dispatch: canonicalise, stable
+    lexicographic sort (all key columns + the row index as the last
+    operand), boundary flags, segment ids, group count."""
+    keys = tuple(_canonical_key(k) for k in keys)
+    sorted_all = jax.lax.sort(
+        keys + (iota,), num_keys=len(keys), is_stable=True
+    )
+    sk, order = sorted_all[:-1], sorted_all[-1]
+    neq = _boundary(sk[0])
+    for k in sk[1:]:
+        neq = neq | _boundary(k)
+    newseg = jnp.concatenate([jnp.ones((1,), bool), neq])
+    gid = jnp.cumsum(newseg.astype(jnp.int32)) - 1
+    return sk, order, gid, newseg, gid[-1] + 1
+
+
+@functools.partial(jax.jit, static_argnames=("pad",))
+def _segment_compact(sk, newseg, pad: int):
+    """Aggregate fast-path stage 2: gather the first row of every group.
+    ``pad`` is the power-of-two-padded group count (executables cache per
+    (shapes, pad), not per exact count); pad entries repeat row 0 and are
+    sliced off by the caller."""
+    idx = jnp.nonzero(newseg, size=pad)[0]
+    return tuple(k[idx] for k in sk)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "kind"))
+def _segment_apply(col, order, gid, num_segments: int, kind: str):
+    """Reorder one data column by the key sort and segment-reduce it —
+    fused into one dispatch (the gather feeds the scatter-reduce)."""
+    red = {
+        "sum": jax.ops.segment_sum,
+        "min": jax.ops.segment_min,
+        "max": jax.ops.segment_max,
+        "prod": jax.ops.segment_prod,
+    }[kind]
+    return red(col[order], gid, num_segments=num_segments)
 
 
 _DEFAULT = Executor()

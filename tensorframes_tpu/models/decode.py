@@ -20,6 +20,7 @@ deep, so sequence parallelism does not apply.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -29,6 +30,27 @@ import numpy as np
 from . import transformer as tfm
 
 Cache = Dict[str, jnp.ndarray]
+
+
+def cast_params(params: tfm.Params, dtype) -> tfm.Params:
+    """Pre-cast float params to the compute dtype ONCE.
+
+    Decode is HBM-bandwidth-bound on the weights: every step otherwise
+    re-reads the f32 master copies and casts at use (``tfm.weight``),
+    doubling the bytes per token.  Casting up front is numerically
+    identical (the same cast, hoisted) and halves the per-step reads.
+    QTensor (int8) leaves pass through — they are already compact."""
+
+    def cast(a):
+        if isinstance(a, tfm.QTensor):
+            return a
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+            return jnp.asarray(a).astype(dtype)
+        return a
+
+    return jax.tree_util.tree_map(
+        cast, params, is_leaf=lambda x: isinstance(x, tfm.QTensor)
+    )
 
 
 def init_cache(
@@ -118,15 +140,23 @@ def sample_logits(
     Static-shape TPU formulation: ``lax.top_k`` for the k filter (no full
     sort in the decode hot loop when only top_k is set); one descending
     sort of the already-filtered logits for the nucleus — masks, no
-    dynamic vocab slicing, one compiled step."""
-    if temperature == 0.0:
+    dynamic vocab slicing, one compiled step.
+
+    ``temperature``/``top_p`` may be traced scalars (one compiled
+    executable serves any value); only ``top_k`` — a shape — and the
+    greedy/nucleus branch choices are trace-time decisions.  Under jit,
+    pass python floats or use the branch-stable values the trace was made
+    with."""
+    if isinstance(temperature, (int, float)) and temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
-    scaled = logits.astype(jnp.float32) / jnp.float32(temperature)
+    scaled = logits.astype(jnp.float32) / jnp.asarray(
+        temperature, jnp.float32
+    )
     neg_inf = jnp.float32(-jnp.inf)
     if top_k > 0:
         kth = jax.lax.top_k(scaled, min(top_k, scaled.shape[-1]))[0][:, -1]
         scaled = jnp.where(scaled >= kth[:, None], scaled, neg_inf)
-    if top_p < 1.0:
+    if not (isinstance(top_p, (int, float)) and top_p >= 1.0):
         # sorted AFTER the k filter: dropped tokens sink to the tail as
         # -inf and carry zero mass, so the nucleus renormalises over the
         # survivors — sequential semantics
@@ -146,32 +176,38 @@ def sample_logits(
     return jax.random.categorical(key, scaled, axis=-1)
 
 
-def generate(
-    params: tfm.Params,
-    prompt: jnp.ndarray,
-    cfg: tfm.TransformerConfig,
-    max_new_tokens: int,
-    temperature: float = 0.0,
-    top_k: int = 0,
-    top_p: float = 1.0,
-    rng: Optional[jax.Array] = None,
-) -> jnp.ndarray:
-    """Autoregressive continuation: prompt [B, Lp] -> [B, Lp + new].
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "max_new_tokens", "top_k", "greedy", "nucleus",
+    ),
+)
+def _generate_jit(
+    params, prompt, rng, temperature, top_p,
+    cfg, max_new_tokens, top_k, greedy, nucleus,
+):
+    """The whole generation — weight cast, prefill, scanned decode — as
+    ONE compiled dispatch (the eager per-op prefill used to dominate
+    single-stream latency over a remote link, docs/PERF.md).
 
-    ``temperature == 0`` decodes greedily; otherwise samples
-    ``softmax(logits / temperature)`` filtered by ``top_k``/``top_p``
-    (``sample_logits``).  Jit-friendly end to end (one prefill trace +
-    one scanned decode-step trace)."""
+    Static args are the ones that change shapes or branches (``cfg``,
+    token count, ``top_k``, greedy/nucleus flags); ``temperature`` and
+    ``top_p`` flow through as traced scalars, so a sampling-parameter
+    sweep reuses one executable instead of recompiling the model per
+    value."""
     B, Lp = prompt.shape
-    if max_new_tokens <= 0:
-        return prompt
-    if rng is None:
-        rng = jax.random.PRNGKey(0)
+    params = cast_params(params, cfg.dtype)
     cache = init_cache(cfg, B, Lp + max_new_tokens)
 
     def sample(logits_last, key):
+        if greedy:
+            return jnp.argmax(logits_last, axis=-1).astype(prompt.dtype)
         return sample_logits(
-            logits_last, key, temperature, top_k, top_p
+            logits_last,
+            key,
+            temperature,
+            top_k,
+            top_p if nucleus else 1.0,
         ).astype(prompt.dtype)
 
     keys = jax.random.split(rng, max_new_tokens)
@@ -189,6 +225,49 @@ def generate(
         [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
     )
     return jnp.concatenate([prompt, new], axis=1)
+
+
+def generate(
+    params: tfm.Params,
+    prompt: jnp.ndarray,
+    cfg: tfm.TransformerConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    rng: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Autoregressive continuation: prompt [B, Lp] -> [B, Lp + new].
+
+    ``temperature == 0`` decodes greedily; otherwise samples
+    ``softmax(logits / temperature)`` filtered by ``top_k``/``top_p``
+    (``sample_logits``).  Compiled end to end: the weight pre-cast,
+    prefill and the scanned decode loop are one jitted executable
+    (cached per (cfg, shapes, sampling knobs)), so a call costs one
+    dispatch + one readback regardless of token count."""
+    if max_new_tokens <= 0:
+        return prompt
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    from .. import observability
+
+    with observability.verb_span(
+        "generate", int(prompt.shape[0]), 1
+    ) as span:
+        out = _generate_jit(
+            params,
+            prompt,
+            rng,
+            jnp.float32(temperature),
+            jnp.float32(top_p),
+            cfg,
+            int(max_new_tokens),
+            int(top_k),
+            greedy=float(temperature) == 0.0,
+            nucleus=float(top_p) < 1.0,
+        )
+        span.mark("dispatch")
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -994,27 +994,6 @@ def _recognize_monoids_uncached(
     return monoids
 
 
-_SEGRED_JIT: Dict[str, Any] = {}
-
-
-def _segment_reduce(data, gid, num_segments: int, kind: str):
-    """One jitted XLA segment reduction; static ``num_segments`` (padded to
-    a power of two by the caller) so executables cache per (shape, padded
-    segment count, kind)."""
-    fn = _SEGRED_JIT.get(kind)
-    if fn is None:
-        fn = _SEGRED_JIT[kind] = jax.jit(
-            {
-                "sum": jax.ops.segment_sum,
-                "min": jax.ops.segment_min,
-                "max": jax.ops.segment_max,
-                "prod": jax.ops.segment_prod,
-            }[kind],
-            static_argnames=("num_segments",),
-        )
-    return fn(data, gid, num_segments=num_segments)
-
-
 def _canonical_key(k):
     """Float keys canonicalised so device grouping matches ``np.unique``:
     -0.0 folds into +0.0 and every NaN payload becomes THE NaN (their

@@ -63,7 +63,13 @@ class MeshExecutor(Executor):
     supports_segment_aggregate = True
 
     def _place_rows(self, arr: jnp.ndarray) -> jnp.ndarray:
-        return jax.device_put(arr, self._shard_for(arr.shape[0]))
+        # one sharding resolution per row count (several columns share it
+        # per aggregate; _shard_for logs on indivisible counts)
+        n = arr.shape[0]
+        cache = self.__dict__.setdefault("_row_sharding_cache", {})
+        if n not in cache:
+            cache[n] = self._shard_for(n)
+        return jax.device_put(arr, cache[n])
 
     def __init__(
         self,

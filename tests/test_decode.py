@@ -313,3 +313,21 @@ def test_speculative_validation_errors(params):
         decode.speculative_generate(
             params, CFG, params, CFG, jnp.zeros((1, 1), jnp.int32), 4
         )
+
+
+def test_generate_temperature_sweep_no_recompile():
+    """temperature/top_p are traced operands (round 4): sweeping them must
+    reuse ONE compiled generation executable, not recompile per value."""
+    from tensorframes_tpu.models.decode import _generate_jit
+
+    cfg = CFG
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    decode.generate(params, prompt, cfg, 4, temperature=0.7, top_p=0.9)
+    n0 = _generate_jit._cache_size()
+    for t in (0.8, 0.9, 1.3):
+        out = decode.generate(
+            params, prompt, cfg, 4, temperature=t, top_p=0.95
+        )
+        assert out.shape == (1, 7)
+    assert _generate_jit._cache_size() == n0  # no new executables

@@ -632,13 +632,24 @@ def apply(
     return out if len(out) > 1 else logits
 
 
-def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
-    """Mean next-token cross-entropy over valid targets (-1 = ignore)."""
+def nll_sum_and_count(
+    logits: jnp.ndarray, targets: jnp.ndarray
+) -> "tuple[jnp.ndarray, jnp.ndarray]":
+    """Summed masked NLL + valid-target count (-1 = ignore) — the single
+    home of the masking numerics shared by :func:`cross_entropy`, the
+    chunked loss, and the 1F1B head (sums combine exactly across chunks
+    and microbatches; divide once, globally)."""
     logp = jax.nn.log_softmax(logits, axis=-1)
     valid = targets >= 0
     safe = jnp.where(valid, targets, 0)
     nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(nll * valid), jnp.sum(valid)
+
+
+def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross-entropy over valid targets (-1 = ignore)."""
+    s, c = nll_sum_and_count(logits, targets)
+    return s / jnp.maximum(c, 1)
 
 
 def cross_entropy_chunked(
@@ -670,14 +681,11 @@ def cross_entropy_chunked(
         logits = jnp.einsum(
             "bcd,dv->bcv", h, w, preferred_element_type=jnp.float32
         )
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        valid = t >= 0
-        safe = jnp.where(valid, t, 0)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        ns, nc = nll_sum_and_count(logits, t)
         s, c = carry
         return (
-            s + jnp.sum(nll * valid).astype(jnp.float32),
-            c + jnp.sum(valid).astype(jnp.int32),
+            s + ns.astype(jnp.float32),
+            c + nc.astype(jnp.int32),
         ), None
 
     (s, c), _ = jax.lax.scan(

@@ -27,9 +27,28 @@ CORPUS = [
 ] * 8
 
 
-def main(steps: int = 60, seq_len: int = 24, vocab: int = 320) -> None:
-    tok = BPETokenizer.train(CORPUS, vocab)
-    print(f"BPE: {tok.vocab_size} tokens, "
+def _tokenizer_corpus() -> list:
+    """A few MB of zipf-distributed synthetic text so the tokenizer can
+    learn a REAL-sized vocabulary (8k+ merges, incremental trainer —
+    round 4); the LM still trains on the small CORPUS above."""
+    rng = np.random.RandomState(7)
+    letters = list("abcdefghijklmnopqrstuvwxyz")
+    bank = [
+        "".join(rng.choice(letters, size=rng.randint(3, 11)))
+        for _ in range(8000)
+    ]
+    idx = rng.zipf(1.3, size=600_000) % len(bank)
+    lines = [" ".join(bank[i] for i in idx[k::100]) for k in range(100)]
+    return CORPUS * 4 + lines
+
+
+def main(steps: int = 60, seq_len: int = 24, vocab: int = 256 + 8192) -> None:
+    import time
+
+    t0 = time.perf_counter()
+    tok = BPETokenizer.train(_tokenizer_corpus(), vocab)
+    print(f"BPE: {tok.vocab_size} tokens trained in "
+          f"{time.perf_counter() - t0:.1f}s; "
           f"{len(tok.encode(CORPUS[0]))} ids for {len(CORPUS[0])} chars")
 
     seqs = [np.asarray(tok.encode(s), np.int32) for s in CORPUS]

@@ -336,6 +336,13 @@ def loss_and_grad_1f1b(
             "pipeline_schedule='1f1b' does not support MoE models yet; "
             "use pipeline_schedule='gpipe'"
         )
+    if cfg.ce_chunk:
+        raise ValueError(
+            "pipeline_schedule='1f1b' computes the head loss per tick "
+            "and does not honour ce_chunk; use pipeline_schedule="
+            "'gpipe' (which chunks via cross_entropy_chunked) or "
+            "ce_chunk=0"
+        )
     if mesh is None:
         mesh = jax.sharding.get_abstract_mesh()
     S, M = tcfg.pp_stages, tcfg.microbatches

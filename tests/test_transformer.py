@@ -640,6 +640,13 @@ def test_1f1b_validation_errors(setup):
         train.make_train_step(
             cfg, train.TrainConfig(pipeline_schedule="bogus")
         )
+    with pytest.raises(ValueError, match="ce_chunk"):
+        train.loss_and_grad_1f1b(
+            params, toks, tgts,
+            dataclasses.replace(cfg, ce_chunk=8),
+            train.TrainConfig(pp_stages=2, microbatches=2,
+                              pipeline_schedule="1f1b"),
+        )
     with jax.set_mesh(make_mesh(pp=2, sp=2, dp=2, tp=1)):
         with pytest.raises(ValueError, match="sp-manual ring"):
             train.loss_and_grad_1f1b(

@@ -100,21 +100,51 @@ def decode_value(v: Any, bins: Optional[List[bytes]] = None) -> Any:
     return v
 
 
+# Every message carries a protocol version: a version-skewed peer (e.g. an
+# attachment-capable writer talking to a pre-attachment reader would leave
+# raw frames in the stream and desync) fails with an immediate, explicit
+# error instead of stream corruption (ADVICE r3).  Bump on wire changes.
+PROTOCOL_VERSION = 2
+
 # The JSON control line must fit in memory (whole-line framing); cap it so
 # a single oversized/malicious request cannot exhaust the server (ADVICE
 # r2).  Bulk data rides the binary attachments under their own cap — the
 # cap IS the per-message/per-connection memory bound (attachments are
-# buffered before dispatch), so it stays modest by default; raise it
-# deliberately alongside allow_remote's trust statement if a deployment
-# really collects multi-GB frames through the bridge.
-MAX_MESSAGE_BYTES = 256 * 1024 * 1024
-MAX_BINARY_BYTES = 1024 * 1024 * 1024  # total attachments per message
+# buffered before dispatch), so both stay modest by default and are
+# DEPLOYMENT-CONFIGURABLE (ADVICE r3): env vars
+# ``TFS_BRIDGE_MAX_MESSAGE_BYTES`` / ``TFS_BRIDGE_MAX_BINARY_BYTES`` at
+# import, or :func:`configure_limits` at runtime — raise them deliberately
+# alongside allow_remote's trust statement if a deployment really collects
+# multi-GB frames through the bridge.
+import os as _os
+
+MAX_MESSAGE_BYTES = int(
+    _os.environ.get("TFS_BRIDGE_MAX_MESSAGE_BYTES", 64 * 1024 * 1024)
+)
+MAX_BINARY_BYTES = int(
+    _os.environ.get("TFS_BRIDGE_MAX_BINARY_BYTES", 256 * 1024 * 1024)
+)
 # attachment COUNT cap: per-bytes-object heap overhead (~50 B) means a
 # huge nbin of tiny chunks could exhaust memory under the byte cap alone
 MAX_BINARY_COUNT = 65_536
 
 
+def configure_limits(
+    max_message_bytes: Optional[int] = None,
+    max_binary_bytes: Optional[int] = None,
+) -> None:
+    """Set the per-message memory caps process-wide (both peers of a
+    connection must agree; the caps bound what one message can make the
+    receiver buffer)."""
+    global MAX_MESSAGE_BYTES, MAX_BINARY_BYTES
+    if max_message_bytes is not None:
+        MAX_MESSAGE_BYTES = int(max_message_bytes)
+    if max_binary_bytes is not None:
+        MAX_BINARY_BYTES = int(max_binary_bytes)
+
+
 def write_message(sock_file, msg: dict, bins: Optional[List[bytes]] = None) -> None:
+    msg = dict(msg, pv=PROTOCOL_VERSION)
     if bins:
         total = sum(len(b) for b in bins)
         if total > MAX_BINARY_BYTES:
@@ -147,6 +177,15 @@ def read_message(sock_file) -> "tuple[dict, List[bytes]]":
             f"bridge message exceeds the {MAX_MESSAGE_BYTES}-byte cap"
         )
     msg = json.loads(line)
+    pv = msg.get("pv")
+    if pv != PROTOCOL_VERSION:
+        raise ConnectionError(
+            f"bridge protocol version skew: peer speaks "
+            f"{'no declared version' if pv is None else f'version {pv}'}, "
+            f"this side speaks {PROTOCOL_VERSION} — upgrade both ends "
+            f"(mixed versions would corrupt the stream at the first "
+            f"binary attachment)"
+        )
     nbin = msg.get("nbin", 0)
     # peer-supplied: a non-int (or bool) here is stream corruption and gets
     # the same clean ConnectionError as every other malformed-stream case

@@ -160,9 +160,9 @@ def pack_examples(
     seq_len: int,
     pad_id: int = 0,
 ):
-    """Greedy first-fit packing of variable-length token sequences into
-    fixed [N, seq_len] rows (best-fit: each piece goes to the open row
-    with the least sufficient space) — no per-example padding waste, the standard
+    """Greedy best-fit packing of variable-length token sequences into
+    fixed [N, seq_len] rows (each piece goes to the open row with the
+    least sufficient space) — no per-example padding waste, the standard
     LM pretraining input shape (static shapes for XLA; the attention mask
     keeps segments independent — ``transformer.apply(segment_ids=...)``).
 

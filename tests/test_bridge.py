@@ -216,3 +216,42 @@ def test_server_collect_payload_goes_binary():
     bins: list = []
     protocol.encode_value(result, bins)
     assert len(bins) == 1 and len(bins[0]) == x.nbytes
+
+
+def test_protocol_version_skew_fails_cleanly():
+    """A peer speaking a different (or no) protocol version must produce
+    an immediate explicit error, never stream desync (ADVICE r3)."""
+    import io
+
+    from tensorframes_tpu.bridge import protocol
+
+    # writer stamps the current version
+    buf = io.BytesIO()
+    protocol.write_message(buf, {"id": 1, "method": "ping", "params": {}})
+    buf.seek(0)
+    msg, bins = protocol.read_message(buf)
+    assert msg["pv"] == protocol.PROTOCOL_VERSION
+
+    # un-versioned (pre-v2) peer line -> clean ConnectionError
+    legacy = io.BytesIO(b'{"id": 1, "method": "ping"}\n')
+    with pytest.raises(ConnectionError, match="version skew"):
+        protocol.read_message(legacy)
+
+    # future-versioned peer -> clean ConnectionError naming both versions
+    future = io.BytesIO(b'{"id": 1, "pv": 99}\n')
+    with pytest.raises(ConnectionError, match="version 99"):
+        protocol.read_message(future)
+
+
+def test_binary_cap_configurable():
+    from tensorframes_tpu.bridge import protocol
+
+    old_b, old_m = protocol.MAX_BINARY_BYTES, protocol.MAX_MESSAGE_BYTES
+    try:
+        protocol.configure_limits(max_binary_bytes=123, max_message_bytes=456)
+        assert protocol.MAX_BINARY_BYTES == 123
+        assert protocol.MAX_MESSAGE_BYTES == 456
+    finally:
+        protocol.configure_limits(
+            max_binary_bytes=old_b, max_message_bytes=old_m
+        )

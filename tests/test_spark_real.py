@@ -1,0 +1,115 @@
+"""REAL-pyspark end-to-end smoke for the Spark front-end (VERDICT r3 #6).
+
+The reference CI runs its Python API under real pyspark with the
+assembled jar (``/root/reference/python/run-tests.sh:79-101``); the
+analog here is ``spark.map_blocks``/``spark.aggregate`` over a genuine
+``local[2]`` SparkSession with an in-process bridge server, exercising
+real ``mapInPandas`` partition functions end to end.
+
+This image cannot host it — the skip below carries the evidence probe
+(run this file to re-check a new image):
+
+* ``import pyspark`` -> ModuleNotFoundError (not bundled);
+* no JRE: ``which java`` empty, no ``/usr/lib/jvm``;
+* ``pip download pyspark`` -> "No matching distribution found"
+  (the environment has zero network egress, and installs are
+  disallowed regardless).
+
+The shim itself is CI-covered against a fake DataFrame implementing the
+exact pyspark surface it touches (``tests/test_spark_shim.py``); this
+file upgrades to the real thing automatically on an image that has
+pyspark + a JRE.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+pyspark = pytest.importorskip(
+    "pyspark",
+    reason=(
+        "real-pyspark smoke blocked in this image: pyspark is not "
+        "bundled, there is no JRE (`which java` is empty, no "
+        "/usr/lib/jvm), and pip has no network egress to fetch either "
+        "(installs are disallowed in this environment anyway) — see "
+        "module docstring; the shim is covered by test_spark_shim.py"
+    ),
+)
+
+if shutil.which("java") is None:  # pragma: no cover - env-dependent
+    pytest.skip(
+        "pyspark importable but no JRE on PATH; cannot launch local[2]",
+        allow_module_level=True,
+    )
+
+
+@pytest.fixture(scope="module")
+def spark():
+    from pyspark.sql import SparkSession
+
+    s = (
+        SparkSession.builder.master("local[2]")
+        .appName("tensorframes_tpu_real_spark_smoke")
+        .config("spark.sql.shuffle.partitions", "4")
+        .getOrCreate()
+    )
+    yield s
+    s.stop()
+
+
+@pytest.fixture(scope="module")
+def bridge():
+    from tensorframes_tpu.bridge import serve
+
+    server = serve()
+    yield server.address
+    server.close()
+
+
+def _graph_bytes(fn_builder):
+    from tensorframes_tpu.graphdef.builder import GraphBuilder
+
+    g = GraphBuilder()
+    fn_builder(g)
+    return g.to_bytes()
+
+
+def test_map_blocks_real_spark(spark, bridge):
+    from tensorframes_tpu import spark as tfs_spark
+
+    df = spark.createDataFrame(
+        [(float(i),) for i in range(20)], ["x"]
+    ).repartition(3)
+
+    def build(g):
+        g.placeholder("x", "float64", [])
+        g.const("three", np.float64(3.0))
+        g.op("Add", "z", ["x", "three"])
+
+    out = tfs_spark.map_blocks(
+        _graph_bytes(build), df, fetches=["z"], address=bridge
+    )
+    rows = {r["x"]: r["z"] for r in out.collect()}
+    assert rows == {float(i): float(i) + 3.0 for i in range(20)}
+
+
+def test_aggregate_real_spark(spark, bridge):
+    from tensorframes_tpu import spark as tfs_spark
+
+    data = [(i % 3, float(i)) for i in range(30)]
+    df = spark.createDataFrame(data, ["k", "v"]).repartition(4)
+
+    def build(g):
+        g.placeholder("v_input", "float64", [-1])
+        g.const("axis", np.int32(0))
+        g.op("Sum", "v", ["v_input", "axis"])
+
+    out = tfs_spark.aggregate(
+        _graph_bytes(build), df, keys=["k"], fetches=["v"], address=bridge
+    )
+    got = dict(zip(np.asarray(out["k"]).tolist(), np.asarray(out["v"])))
+    expect = {}
+    for k, v in data:
+        expect[k] = expect.get(k, 0.0) + v
+    assert got == pytest.approx(expect)

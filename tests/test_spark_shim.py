@@ -157,3 +157,23 @@ def test_empty_dataframe_reduce_raises(address):
     g.op("Sum", "x", ["x_input", "axis"])
     with pytest.raises(ValueError, match="empty"):
         tsp.reduce_blocks(g.to_bytes(), df, address, fetches=["x"])
+
+
+def test_group_by_compat_wrapper(address):
+    """The reference-shaped call (core.py:319-336 aggregates a grouped
+    DataFrame): group_by(df, key).aggregate(program) == aggregate(df, keys)."""
+    df, pdf = _df()
+    g = GraphBuilder()
+    g.placeholder("x_input", "float64", [-1])
+    g.const("axis", np.int32(0))
+    g.op("Sum", "x", ["x_input", "axis"])
+    out = tsp.group_by(df, "k").aggregate(
+        g.to_bytes(), address=address, fetches=["x"]
+    )
+    ref = tsp.aggregate(
+        g.to_bytes(), df, keys=["k"], address=address, fetches=["x"]
+    )
+    np.testing.assert_array_equal(np.asarray(out["k"]), np.asarray(ref["k"]))
+    np.testing.assert_allclose(np.asarray(out["x"]), np.asarray(ref["x"]))
+    with pytest.raises(ValueError, match="at least one key"):
+        tsp.group_by(df)

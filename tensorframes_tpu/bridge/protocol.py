@@ -118,11 +118,25 @@ PROTOCOL_VERSION = 2
 # multi-GB frames through the bridge.
 import os as _os
 
-MAX_MESSAGE_BYTES = int(
-    _os.environ.get("TFS_BRIDGE_MAX_MESSAGE_BYTES", 64 * 1024 * 1024)
+
+def _env_bytes(name: str, default: int) -> int:
+    raw = _os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"environment variable {name} must be an integer byte count, "
+            f"got {raw!r}"
+        ) from None
+
+
+MAX_MESSAGE_BYTES = _env_bytes(
+    "TFS_BRIDGE_MAX_MESSAGE_BYTES", 64 * 1024 * 1024
 )
-MAX_BINARY_BYTES = int(
-    _os.environ.get("TFS_BRIDGE_MAX_BINARY_BYTES", 256 * 1024 * 1024)
+MAX_BINARY_BYTES = _env_bytes(
+    "TFS_BRIDGE_MAX_BINARY_BYTES", 256 * 1024 * 1024
 )
 # attachment COUNT cap: per-bytes-object heap overhead (~50 B) means a
 # huge nbin of tiny chunks could exhaust memory under the byte cap alone

@@ -207,6 +207,11 @@ class BridgeServer(socketserver.ThreadingTCPServer):
     def address(self):
         return self.server_address
 
+    def close(self) -> None:
+        """Stop serving and release the socket (shutdown + server_close)."""
+        self.shutdown()
+        self.server_close()
+
 
 def serve(
     host: str = "127.0.0.1",

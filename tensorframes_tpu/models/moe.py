@@ -189,9 +189,9 @@ def routing_stats(bp, y: jnp.ndarray, cfg, segments=None) -> dict:
     return {
         "load": np.asarray(load, dtype=np.float64),
         "prob": np.asarray(jnp.mean(probs, axis=(0, 1)), dtype=np.float64),
-        # max(total, 1): an all-padding batch has zero routable slots and
-        # must report drop 0, not divide by zero (ADVICE r3)
-        "drop_fraction": 1.0 - assigned / max(total, 1),
+        # an all-padding batch has zero routable slots: report drop 0
+        # (nothing to drop), never divide by zero (ADVICE r3)
+        "drop_fraction": (1.0 - assigned / total) if total else 0.0,
         "capacity": cap,
         "aux": float(aux),
     }

@@ -245,21 +245,31 @@ def _schema_via_analysis(graph, fetches, inputs, head_pdf, trim, keys=()):
         summaries = program.analyze(specs)
     except Exception:
         return None
+    # field ORDER must match the executed output exactly (mapInPandas
+    # binds batches against this schema): the engine emits keys first
+    # (aggregate), then outputs sorted by name, then non-shadowed
+    # passthrough columns in frame order — an output SHADOWS a same-named
+    # input (engine _build_map_output), so shadowed inputs must not
+    # produce duplicate fields here
     fields = []
     for k in keys:
         if head_pdf.dtypes[k] == object:
             return None
         fields.append(_field_for(k, np.dtype(head_pdf.dtypes[k]), 0))
-    if not trim and not keys:
-        for col in head_pdf.columns:  # map verbs append their inputs
-            if head_pdf.dtypes[col] == object:
-                return None
-            fields.append(_field_for(col, np.dtype(head_pdf.dtypes[col]), 0))
+    out_names = set()
     for s in summaries:
         if s.is_output:
+            out_names.add(s.name)
             fields.append(
                 _field_for(s.name, s.scalar_type.np_dtype, len(s.shape) - 1)
             )
+    if not trim and not keys:
+        for col in head_pdf.columns:  # map verbs append their inputs
+            if col in out_names:
+                continue  # output shadows the passthrough column
+            if head_pdf.dtypes[col] == object:
+                return None
+            fields.append(_field_for(col, np.dtype(head_pdf.dtypes[col]), 0))
     return T.StructType(fields)
 
 

@@ -140,6 +140,76 @@ def step(
     return np.where(counts[:, None] > 0, new, centers)
 
 
+def _init_centers(
+    frame: TensorFrame,
+    k: int,
+    seed: int,
+    init_centers: Optional[np.ndarray],
+) -> np.ndarray:
+    """k-means++-style greedy farthest-point seeding (deterministic)."""
+    if init_centers is not None:
+        return np.asarray(init_centers, dtype=np.float64).copy()
+    pts = np.asarray(frame.column("points").data, dtype=np.float64)
+    rng = np.random.RandomState(seed)
+    chosen = [rng.randint(len(pts))]
+    # greedy farthest-point: track the running min-distance to the
+    # chosen set and fold in only the newest center — O(n*d) per center
+    # (the naive n x k x d broadcast is gigabytes at demo scale)
+    d2 = ((pts - pts[chosen[0]]) ** 2).sum(-1)
+    for _ in range(k - 1):
+        chosen.append(int(np.argmax(d2)))
+        np.minimum(d2, ((pts - pts[chosen[-1]]) ** 2).sum(-1), out=d2)
+    return pts[chosen].copy()
+
+
+def make_pipeline(frame: TensorFrame, centers):
+    """The whole Lloyd iteration as ONE fused dispatch (``tfs.pipeline``):
+    per-block pre-aggregation -> cross-block combine -> center update,
+    with the centers carried on device between iterations
+    (``pipe.iterate``).  This is the fused form of the demo's fast path
+    (``kmeans_demo.py:101-168``) taken one step further: the demo fuses
+    assignment+pre-aggregation into one graph but still pays a dispatch
+    per verb and a readback per iteration; here ``iterate(K)`` runs K
+    full Lloyd iterations in one dispatch."""
+    from ..ops.pipeline import pipeline
+
+    prog = preagg_program(centers)
+
+    def update(row, params):
+        sums, counts = row["psum"], row["pcount"]
+        safe = jnp.where(counts > 0, counts, 1.0)
+        new = sums / safe[:, None]
+        # empty clusters keep their previous center (MLlib semantics)
+        new = jnp.where(counts[:, None] > 0, new, params["centers"])
+        return {"centers": new.astype(params["centers"].dtype)}
+
+    pipe = (
+        pipeline(frame)
+        .map_blocks(prog, trim=True)
+        .reduce_blocks(Program.wrap(_combine_fn))
+        .then(update)
+    )
+    return pipe, prog
+
+
+def fit_fused(
+    frame: TensorFrame,
+    k: int,
+    num_iters: int = 10,
+    seed: int = 0,
+    init_centers: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """``fit(strategy="preagg")`` with ALL ``num_iters`` Lloyd iterations
+    in one device dispatch (same numerics, same init; single-chip)."""
+    centers = _init_centers(frame, k, seed, init_centers)
+    pipe, prog = make_pipeline(frame, centers)
+    finals, _ = pipe.iterate(num_iters, carry={"centers": "centers"})
+    centers = np.asarray(finals["centers"], dtype=np.float64)
+    assign = assignment_program(centers)
+    assigned = map_blocks(assign, frame)
+    return centers, np.asarray(assigned.to_arrays()["closest"])
+
+
 def fit(
     frame: TensorFrame,
     k: int,
@@ -152,20 +222,7 @@ def fit(
     """Lloyd's algorithm on column ``points`` [n, d].  Returns
     (centers [k, d], assignments [n]).  Default init is k-means++-style
     greedy farthest-point seeding (deterministic given ``seed``)."""
-    pts = np.asarray(frame.column("points").data, dtype=np.float64)
-    if init_centers is not None:
-        centers = np.asarray(init_centers, dtype=np.float64).copy()
-    else:
-        rng = np.random.RandomState(seed)
-        chosen = [rng.randint(len(pts))]
-        # greedy farthest-point: track the running min-distance to the
-        # chosen set and fold in only the newest center — O(n*d) per center
-        # (the naive n x k x d broadcast is gigabytes at demo scale)
-        d2 = ((pts - pts[chosen[0]]) ** 2).sum(-1)
-        for _ in range(k - 1):
-            chosen.append(int(np.argmax(d2)))
-            np.minimum(d2, ((pts - pts[chosen[-1]]) ** 2).sum(-1), out=d2)
-        centers = pts[chosen].copy()
+    centers = _init_centers(frame, k, seed, init_centers)
     programs: dict = {}
     for _ in range(num_iters):
         centers = np.asarray(

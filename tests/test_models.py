@@ -158,3 +158,22 @@ class TestKMeans:
         got = kmeans.step(init, frame, strategy="preagg", engine=eng)
         want = kmeans.step(init, frame, strategy="preagg")
         np.testing.assert_allclose(got, want, rtol=1e-8)
+
+
+def test_kmeans_fused_matches_eager():
+    """fit_fused (all Lloyd iterations in one dispatch via
+    tfs.pipeline.iterate) == fit(strategy='preagg') exactly."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.models import kmeans
+
+    rng = np.random.RandomState(3)
+    pts = np.concatenate(
+        [rng.randn(40, 3) + c for c in (0.0, 6.0, -6.0)]
+    )
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"points": pts}, num_blocks=4)
+    )
+    c_e, a_e = kmeans.fit(frame, k=3, num_iters=7, strategy="preagg")
+    c_f, a_f = kmeans.fit_fused(frame, k=3, num_iters=7)
+    np.testing.assert_allclose(c_f, c_e, rtol=1e-6)
+    np.testing.assert_array_equal(a_f, a_e)

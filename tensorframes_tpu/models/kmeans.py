@@ -200,9 +200,13 @@ def fit_fused(
     init_centers: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """``fit(strategy="preagg")`` with ALL ``num_iters`` Lloyd iterations
-    in one device dispatch (same numerics, same init; single-chip)."""
+    in one device dispatch (same init; single-chip).  Numerics match the
+    eager path exactly under x64 (the test-mesh parity pin); on TPU f32
+    the fused center update runs on device where the eager path divides
+    on host in f64, so centers can drift ~1e-2 relative over many
+    iterations on clusterless data (docs/PERF.md)."""
     centers = _init_centers(frame, k, seed, init_centers)
-    pipe, prog = make_pipeline(frame, centers)
+    pipe, _ = make_pipeline(frame, centers)
     finals, _ = pipe.iterate(num_iters, carry={"centers": "centers"})
     centers = np.asarray(finals["centers"], dtype=np.float64)
     assign = assignment_program(centers)

@@ -39,8 +39,16 @@ build time:
 * ``aggregate`` is not fusable (its group structure is data-dependent); use
   the eager verb.
 
-This executor is single-device by design — the fused executable targets one
-chip; ``parallel.MeshExecutor`` distributes the *eager* verbs over a mesh.
+Mesh composition: ``tfs.pipeline(frame, engine=MeshExecutor(mesh))`` runs
+the SAME fused chain with the source columns sharded over the executor's
+data axis and the whole frame treated as ONE logical block (the mesh
+executor's ``global`` semantics) — GSPMD partitions the fused executable
+and lowers the reduce stages' cross-shard combines onto ICI collectives.
+Size row counts as a multiple of the data axis: other counts degrade to
+the largest-divisor sub-mesh (``_shard_for``'s logged fallback — padding
+is not semantics-safe for arbitrary cross-row programs).  Per-block
+("partition") semantics stay with the eager ``MeshExecutor`` verbs;
+``mode="per_block"`` executors are rejected here.
 """
 
 from __future__ import annotations
@@ -100,9 +108,13 @@ class Pipeline:
         visible: Optional[Dict[str, ColumnInfo]] = None,
         from_source: Optional[Dict[str, bool]] = None,
         row_stage: bool = False,
+        engine=None,
     ):
         self._frame = frame
         self._stages = stages
+        # a MeshExecutor engine switches the chain to mesh-global
+        # semantics: one logical block, rows sharded over the data axis
+        self._engine = engine
         if visible is None:
             visible = {}
             from_source = {}
@@ -207,6 +219,7 @@ class Pipeline:
             self._stages + (_Stage("map_blocks", program, trim=trim),),
             visible,
             from_source,
+            engine=self._engine,
         )
 
     def map_blocks_trimmed(self, fn, **kw) -> "Pipeline":
@@ -225,6 +238,7 @@ class Pipeline:
             self._stages + (_Stage("map_rows", program),),
             visible,
             from_source,
+            engine=self._engine,
         )
 
     def reduce_blocks(self, fn, **kw) -> "Pipeline":
@@ -262,6 +276,7 @@ class Pipeline:
             self._visible,
             self._from_source,
             row_stage=True,
+            engine=self._engine,
         )
 
     def reduce_rows(self, fn, mode: str = "tree", **kw) -> "Pipeline":
@@ -300,6 +315,7 @@ class Pipeline:
             self._visible,
             self._from_source,
             row_stage=True,
+            engine=self._engine,
         )
 
     def then(self, fn: Callable) -> "Pipeline":
@@ -330,6 +346,7 @@ class Pipeline:
             self._visible,
             self._from_source,
             row_stage=True,
+            engine=self._engine,
         )
 
     # --------------------------------------------------------------- trace --
@@ -362,15 +379,28 @@ class Pipeline:
         }
         return sorted(needed & src_names)
 
+    @property
+    def _mesh_mode(self) -> bool:
+        """True when the chain runs mesh-global: one logical block, rows
+        sharded over the engine's data axis (duck-typed MeshExecutor)."""
+        return self._engine is not None and hasattr(self._engine, "mesh")
+
     def _body(self, cols: Dict[str, Any], params_list: List[Dict]) -> Any:
         """The traced chain: cols are full source columns; returns either the
         final row dict or the list of per-block column dicts."""
         frame = self._frame
-        offsets = frame.offsets
         src_schema = frame.schema
+        if self._mesh_mode:
+            # mesh-global semantics: the whole frame is ONE logical block
+            # (GSPMD partitions the trace over the sharded rows)
+            ranges = [(0, frame.num_rows)]
+        else:
+            ranges = [
+                (frame.offsets[i], frame.offsets[i + 1])
+                for i in range(frame.num_blocks)
+            ]
         blocks: List[Dict[str, Any]] = []
-        for i in range(frame.num_blocks):
-            lo, hi = offsets[i], offsets[i + 1]
+        for lo, hi in ranges:
             # empty blocks flow through map stages (eager parity: map verbs
             # emit one output block per input block, empty included); the
             # reduce stages skip them below, like the engine's guards
@@ -563,6 +593,10 @@ class Pipeline:
                 data = np.asarray(data)
                 if data.dtype != st.np_dtype:
                     data = data.astype(st.np_dtype)
+            if self._mesh_mode:
+                # rows land sharded over the engine's data axis; GSPMD
+                # propagates from these input shardings through the trace
+                data = self._engine._place_rows(jnp.asarray(data))
             cols[name] = data
         return cols
 
@@ -681,6 +715,20 @@ class Pipeline:
             return finals, hist
 
 
-def pipeline(frame: TensorFrame) -> Pipeline:
-    """Start a fused verb chain over ``frame`` (see :class:`Pipeline`)."""
-    return Pipeline(frame)
+def pipeline(frame: TensorFrame, engine=None) -> Pipeline:
+    """Start a fused verb chain over ``frame`` (see :class:`Pipeline`).
+
+    ``engine``: pass a ``parallel.MeshExecutor`` to run the chain
+    mesh-global — source columns sharded over its data axis, reduce
+    combines on ICI (module docstring)."""
+    if (
+        engine is not None
+        and hasattr(engine, "mesh")
+        and getattr(engine, "mode", "global") != "global"
+    ):
+        raise ValidationError(
+            "pipeline: a fused chain has exactly one logical block, so "
+            "only mode='global' MeshExecutors compose with it; per-block "
+            "(partition) semantics need the eager MeshExecutor verbs."
+        )
+    return Pipeline(frame, engine=engine)

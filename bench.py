@@ -1,12 +1,11 @@
-"""Benchmarks: the five BASELINE.md configs + the flagship train step,
+"""Benchmarks: the BASELINE.md configs + the flagship train/serve steps,
 one JSON line each.
 
 The headline (printed LAST so the driver's last-line parse records it) is
 config #4 — Inception-v3 ``map_blocks`` image scoring, the reference's
-flagship workload (``read_image.py:108-167``).  The other five lines cover
-the remaining BASELINE.md matrix (VERDICT r2 missing #5) plus the
-train-step throughput of the flagship transformer (net-new capability —
-the reference has no training loop):
+flagship workload (``read_image.py:108-167``).  The other lines cover the
+remaining BASELINE.md matrix plus the net-new flagship rows (the
+reference has no training loop or serving path):
 
 | # | config | reference path |
 |---|---|---|

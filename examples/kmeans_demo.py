@@ -78,6 +78,22 @@ def main(n=100_000, d=100, k=10, iters=10):
         np.asarray(centers)
         results[f"tfs_{strategy}"] = time.perf_counter() - t0
 
+    # round 4: the whole Lloyd loop as ONE fused dispatch
+    # (tfs.pipeline.iterate — centers never leave HBM between iterations).
+    # Warm the ACTUAL compiled loop (same pipeline, same step count), reset
+    # the centers, then time just the iteration chain — the same scope the
+    # eager strategies time above.
+    import jax.numpy as jnp
+
+    pipe, fused_prog = kmeans.make_pipeline(frame, init)
+    carry = {"centers": "centers"}
+    pipe.iterate(iters, carry=carry)  # warm: compiles the K-step scan
+    fused_prog.update_params(centers=jnp.asarray(init))  # back to init
+    t0 = time.perf_counter()
+    finals, _ = pipe.iterate(iters, carry=carry)
+    fused_centers = np.asarray(finals["centers"])
+    results["tfs_fused"] = time.perf_counter() - t0
+
     t0 = time.perf_counter()
     oracle = numpy_lloyd(points, np.asarray(init), iters)
     results["numpy_cpu"] = time.perf_counter() - t0
@@ -86,6 +102,8 @@ def main(n=100_000, d=100, k=10, iters=10):
         print(f"{name:>14}: {secs:7.3f}s for {iters} iterations")
     drift = float(np.abs(np.asarray(centers) - oracle).max())
     print(f"max |tfs - numpy| center drift: {drift:.5f}")
+    fused_drift = float(np.abs(fused_centers - oracle).max())
+    print(f"max |fused - numpy| center drift: {fused_drift:.5f}")
 
 
 if __name__ == "__main__":

@@ -47,6 +47,10 @@ def test_kmeans_demo_example(capsys):
     mod["main"](n=2_000, d=16, k=4, iters=2)
     out = capsys.readouterr().out
     assert "tfs_preagg" in out and "numpy_cpu" in out
+    assert "tfs_fused" in out
+    # the fused path's numerics are validated against the numpy oracle
+    fused_line = [l for l in out.splitlines() if "fused - numpy" in l][0]
+    assert float(fused_line.split(":")[1]) < 1e-2
 
 
 def test_logreg_example(capsys):

@@ -162,7 +162,7 @@ def _init_centers(
     return pts[chosen].copy()
 
 
-def make_pipeline(frame: TensorFrame, centers):
+def make_pipeline(frame: TensorFrame, centers, engine=None):
     """The whole Lloyd iteration as ONE fused dispatch (``tfs.pipeline``):
     per-block pre-aggregation -> cross-block combine -> center update,
     with the centers carried on device between iterations
@@ -184,7 +184,7 @@ def make_pipeline(frame: TensorFrame, centers):
         return {"centers": new.astype(params["centers"].dtype)}
 
     pipe = (
-        pipeline(frame)
+        pipeline(frame, engine=engine)
         .map_blocks(prog, trim=True)
         .reduce_blocks(Program.wrap(_combine_fn))
         .then(update)
@@ -198,19 +198,21 @@ def fit_fused(
     num_iters: int = 10,
     seed: int = 0,
     init_centers: Optional[np.ndarray] = None,
+    engine=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """``fit(strategy="preagg")`` with ALL ``num_iters`` Lloyd iterations
     in one device dispatch (same init; single-chip).  Numerics match the
     eager path exactly under x64 (the test-mesh parity pin); on TPU f32
     the fused center update runs on device where the eager path divides
     on host in f64, so centers can drift ~1e-2 relative over many
-    iterations on clusterless data (docs/PERF.md)."""
+    iterations on clusterless data (docs/PERF.md).  Pass a
+    ``MeshExecutor`` as ``engine`` to run the fused loop mesh-global."""
     centers = _init_centers(frame, k, seed, init_centers)
-    pipe, _ = make_pipeline(frame, centers)
+    pipe, _ = make_pipeline(frame, centers, engine=engine)
     finals, _ = pipe.iterate(num_iters, carry={"centers": "centers"})
     centers = np.asarray(finals["centers"], dtype=np.float64)
     assign = assignment_program(centers)
-    assigned = map_blocks(assign, frame)
+    assigned = map_blocks(assign, frame, engine=engine)
     return centers, np.asarray(assigned.to_arrays()["closest"])
 
 

@@ -142,7 +142,7 @@ def fit(
     return params, losses
 
 
-def make_pipeline(frame: TensorFrame, lr: float, params=None):
+def make_pipeline(frame: TensorFrame, lr: float, params=None, engine=None):
     """The full training step as ONE fused dispatch (``tfs.pipeline``).
 
     grad partials -> cross-block sum -> SGD update, compiled into a single
@@ -171,7 +171,7 @@ def make_pipeline(frame: TensorFrame, lr: float, params=None):
         }
 
     pipe = (
-        pipeline(frame)
+        pipeline(frame, engine=engine)
         .map_blocks(gprog, trim=True)
         .reduce_blocks(Program.wrap(_sum_program()))
         .then(update)
@@ -199,15 +199,16 @@ def fit_fused(
     lr: float = 0.5,
     feature_col: str = "features",
     label_col: str = "label",
+    engine=None,
 ):
     """``fit`` with the whole training loop in ONE device dispatch.
 
     Numerically identical to :func:`fit` (same per-step computation, same
     fp order); the only host round trips are the final params/loss-history
-    readback.  The fused executable targets one chip — for mesh execution
-    use :func:`fit` with a ``MeshExecutor`` engine."""
+    readback.  Pass a ``MeshExecutor`` as ``engine`` to run the fused
+    loop mesh-global (rows sharded over dp, combines on ICI)."""
     frame = _canonical_frame(frame, feature_col, label_col)
-    pipe, _ = make_pipeline(frame, lr)
+    pipe, _ = make_pipeline(frame, lr, engine=engine)
     finals, hist = pipe.iterate(
         num_iters, carry={"w": "w", "b": "b"}, collect=("loss",)
     )

@@ -340,3 +340,36 @@ def test_mesh_pipeline_parity():
     w2, l2 = run_iterate(eng)
     np.testing.assert_allclose(w2, w1, rtol=1e-5)
     np.testing.assert_allclose(l2, l1, rtol=1e-5)
+
+
+def test_fused_models_on_mesh():
+    """fit_fused drivers accept a MeshExecutor and match single-device."""
+    from tensorframes_tpu.models import kmeans, logistic_regression as lr
+    from tensorframes_tpu.parallel.dist import MeshExecutor
+    from tensorframes_tpu.parallel.mesh import data_mesh
+
+    eng = MeshExecutor(data_mesh())
+    rng = np.random.RandomState(2)
+    n, d = 160, 4
+    feats = rng.rand(n, d).astype(np.float32)
+    labels = (feats @ rng.randn(d) > 0).astype(np.float32)
+    fr = tfs.analyze(
+        tfs.TensorFrame.from_arrays(
+            {"features": feats, "label": labels}, num_blocks=2
+        )
+    )
+    p1, l1 = lr.fit_fused(fr, num_iters=5, lr=0.5)
+    p2, l2 = lr.fit_fused(fr, num_iters=5, lr=0.5, engine=eng)
+    np.testing.assert_allclose(
+        np.asarray(p2["w"]), np.asarray(p1["w"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(l2, l1, rtol=1e-5)
+
+    pts = np.concatenate([rng.randn(40, 3) + c for c in (0.0, 8.0)])
+    kfr = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"points": pts}, num_blocks=2)
+    )
+    c1, a1 = kmeans.fit_fused(kfr, k=2, num_iters=5)
+    c2, a2 = kmeans.fit_fused(kfr, k=2, num_iters=5, engine=eng)
+    np.testing.assert_allclose(c2, c1, rtol=1e-6)
+    np.testing.assert_array_equal(a2, a1)

@@ -201,7 +201,7 @@ def fit_fused(
     engine=None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """``fit(strategy="preagg")`` with ALL ``num_iters`` Lloyd iterations
-    in one device dispatch (same init; single-chip).  Numerics match the
+    in one device dispatch (same init).  Numerics match the
     eager path exactly under x64 (the test-mesh parity pin); on TPU f32
     the fused center update runs on device where the eager path divides
     on host in f64, so centers can drift ~1e-2 relative over many

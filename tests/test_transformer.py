@@ -664,3 +664,28 @@ def test_1f1b_validation_errors(setup):
                 train.TrainConfig(pp_stages=2, microbatches=2,
                                   pipeline_schedule="1f1b"),
             )
+
+
+def test_1f1b_composes_with_gspmd_sp(setup):
+    """1F1B + an sp axis under FULL attention: the sequence shards via
+    GSPMD (auto axes) inside the stage bodies — only the sp-MANUAL ring
+    kernels are excluded from this schedule."""
+    cfg, params, toks, tgts = setup
+    tcfg = train.TrainConfig(
+        pp_stages=2, microbatches=4, pipeline_schedule="1f1b"
+    )
+    l0, g0 = jax.value_and_grad(tfm.loss_fn)(params, toks, tgts, cfg)
+    with jax.set_mesh(make_mesh(pp=2, dp=2, sp=2)):
+        l1, g1 = jax.jit(
+            lambda p: train.loss_and_grad_1f1b(p, toks, tgts, cfg, tcfg)
+        )(params)
+    assert float(l0) == pytest.approx(float(l1), rel=1e-5)
+    assert jax.tree_util.tree_structure(g0) == jax.tree_util.tree_structure(
+        g1
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        )

@@ -164,7 +164,8 @@ def write_message(sock_file, msg: dict, bins: Optional[List[bytes]] = None) -> N
         if total > MAX_BINARY_BYTES:
             raise ValueError(
                 f"bridge binary payload of {total} bytes exceeds the "
-                f"{MAX_BINARY_BYTES}-byte cap"
+                f"{MAX_BINARY_BYTES}-byte cap; raise it on BOTH peers via "
+                f"TFS_BRIDGE_MAX_BINARY_BYTES or configure_limits()"
             )
         msg = dict(msg, nbin=len(bins))
     data = json.dumps(msg).encode() + b"\n"
@@ -172,7 +173,9 @@ def write_message(sock_file, msg: dict, bins: Optional[List[bytes]] = None) -> N
         raise ValueError(
             f"bridge message of {len(data)} bytes exceeds the "
             f"{MAX_MESSAGE_BYTES}-byte cap; move bulk data out of band "
-            f"(large tensors should ride the binary attachments)"
+            f"(large tensors should ride the binary attachments), or raise "
+            f"the cap on BOTH peers via TFS_BRIDGE_MAX_MESSAGE_BYTES or "
+            f"configure_limits()"
         )
     sock_file.write(data)
     for b in bins or ():
@@ -188,7 +191,9 @@ def read_message(sock_file) -> "tuple[dict, List[bytes]]":
         raise ConnectionError("bridge peer closed the connection")
     if len(line) > MAX_MESSAGE_BYTES:
         raise ConnectionError(
-            f"bridge message exceeds the {MAX_MESSAGE_BYTES}-byte cap"
+            f"bridge message exceeds the {MAX_MESSAGE_BYTES}-byte cap "
+            f"(TFS_BRIDGE_MAX_MESSAGE_BYTES / configure_limits() raise it, "
+            f"on both peers)"
         )
     msg = json.loads(line)
     pv = msg.get("pv")

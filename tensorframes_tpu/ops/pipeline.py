@@ -99,6 +99,15 @@ class Pipeline:
     Builder methods return a NEW Pipeline (the receiver stays valid), so
     chains can fork.  Compilation happens at the first ``run``/``collect``/
     ``iterate`` and is cached on the terminal Pipeline object.
+
+    Forks share STAGE STATE, not just structure: stages hold the caller's
+    ``Program`` objects by reference, and ``iterate``'s resume contract
+    updates those programs' params in place — deliberately, so the
+    caller's own handle (and any sibling fork) continues from the trained
+    state, exactly like calling ``program.update_params`` yourself.  If a
+    fork must iterate from pristine params, give it its own ``Program``
+    (``Program(graphdef, **initial_params)``) rather than sharing one
+    across forks (ADVICE r4).
     """
 
     def __init__(

@@ -257,12 +257,18 @@ def _schema_via_analysis(graph, fetches, inputs, head_pdf, trim, keys=()):
             return None
         fields.append(_field_for(k, np.dtype(head_pdf.dtypes[k]), 0))
     out_names = set()
-    for s in summaries:
-        if s.is_output:
-            out_names.add(s.name)
-            fields.append(
-                _field_for(s.name, s.scalar_type.np_dtype, len(s.shape) - 1)
-            )
+    # sort explicitly rather than relying on analyze()'s internal summary
+    # order staying aligned with the engine's sorted-by-name emission —
+    # mapInPandas binds batches positionally, so drift would corrupt
+    # columns silently (ADVICE r4)
+    out_summaries = sorted(
+        (s for s in summaries if s.is_output), key=lambda s: s.name
+    )
+    for s in out_summaries:
+        out_names.add(s.name)
+        fields.append(
+            _field_for(s.name, s.scalar_type.np_dtype, len(s.shape) - 1)
+        )
     if not trim and not keys:
         for col in head_pdf.columns:  # map verbs append their inputs
             if col in out_names:

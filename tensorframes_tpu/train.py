@@ -23,6 +23,7 @@ inherits the params' sharding under jit.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -32,6 +33,8 @@ from jax.sharding import PartitionSpec as P
 
 from .models import transformer as tfm
 from .models.transformer import Params, TransformerConfig, shard
+
+_log = logging.getLogger("tensorframes_tpu.train")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -352,6 +355,19 @@ def loss_and_grad_1f1b(
         or "pp" not in mesh.axis_names
         or mesh.shape["pp"] == 1
     ):
+        if S > 1:
+            # pp_stages>1 with no usable pp mesh axis is almost always a
+            # missing jax.set_mesh at the call site — surface it instead
+            # of silently training single-stage (ADVICE r4)
+            _log.warning(
+                "loss_and_grad_1f1b: pp_stages=%d but %s; running "
+                "SINGLE-stage (no pipeline parallelism). Enter the mesh "
+                "with jax.set_mesh(...) or pass mesh= explicitly.",
+                S,
+                "no ambient mesh is set"
+                if mesh is None or not mesh.axis_names
+                else "the mesh has no pp axis of size>1",
+            )
         loss, grads = jax.value_and_grad(tfm.loss_fn)(
             params, tokens, targets, cfg
         )

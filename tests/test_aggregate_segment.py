@@ -108,17 +108,27 @@ def test_segment_outputs_stay_on_device():
     assert out.column("k").is_device
 
 
-def test_fallback_to_general_path_non_monoid(monkeypatch):
+def test_fallback_to_general_path_non_segmentable(monkeypatch):
+    """A program the segment compiler cannot express (cross-row sort:
+    per-group median) takes the general bucketed/tree path.  (Round 4
+    used ``abs(x).sum(0)`` here — that now runs on device via the plan
+    path, covered by test_segment_plan_* below.)"""
     calls = _spy(monkeypatch)
     rng = np.random.RandomState(3)
     n = 60
     vals = rng.rand(n)
     f = _frame(rng.randint(0, 5, n), vals)
     out = tfs.aggregate(
-        lambda v_input: {"v": jnp.abs(v_input).sum(0)}, tfs.group_by(f, "k")
+        lambda v_input: {"v": jnp.sort(v_input)[0]}, tfs.group_by(f, "k")
     )
     assert calls["n"] >= 1  # general path dispatched groups
-    assert out.num_rows > 0
+    arrs = out.to_arrays()
+    ks = np.asarray(arrs["k"])
+    for i, k in enumerate(ks):
+        np.testing.assert_allclose(
+            np.asarray(arrs["v"])[i], vals[np.asarray(f.column("k").data) == k].min(),
+            rtol=1e-6,
+        )
 
 
 def test_segment_float_keys(monkeypatch):
@@ -325,3 +335,269 @@ def test_recognition_memoized_one_trace():
     tfs.aggregate(p, g)
     tfs.aggregate(p, g)
     assert traces["n"] == n_after_first  # no re-trace on repeat calls
+
+
+# ---------------------------------------------------------------------------
+# round 5: generalized segment plans (VERDICT r4 weak #5 / next #8) — mean,
+# sum-of-squares, weighted sums etc. compile to pre -> segment -> post
+# ---------------------------------------------------------------------------
+
+
+def _no_host_spies(monkeypatch, executor_cls=Executor):
+    """Spy on both escape hatches of the fast path: the vmapped group
+    dispatch (general path) and np.unique (host group-index build)."""
+    calls = {"groups": 0, "unique": 0}
+    orig_run = executor_cls._run_groups
+
+    def run_spy(self, vrun, batch):
+        calls["groups"] += 1
+        return orig_run(self, vrun, batch)
+
+    monkeypatch.setattr(executor_cls, "_run_groups", run_spy)
+    orig_unique = np.unique
+
+    def unique_spy(*a, **kw):
+        calls["unique"] += 1
+        return orig_unique(*a, **kw)
+
+    monkeypatch.setattr(np, "unique", unique_spy)
+    return calls
+
+
+def test_segment_plan_mean_device_path(monkeypatch):
+    """``mean`` provably takes the device path: zero group dispatches,
+    zero host ``np.unique`` calls (VERDICT r4 next #8's done criterion)."""
+    calls = _no_host_spies(monkeypatch)
+    rng = np.random.RandomState(21)
+    keys = rng.randint(-4, 9, size=500)
+    vals = rng.rand(500) * 3 - 1
+    out = tfs.aggregate(
+        lambda v_input: {"v": v_input.mean(0)},
+        tfs.group_by(_frame(keys, vals, blocks=2), "k"),
+    )
+    assert calls["groups"] == 0 and calls["unique"] == 0
+    arrs = out.to_arrays()
+    ks = np.asarray(arrs["k"])
+    expect = np.array([vals[keys == k].mean() for k in ks])
+    np.testing.assert_allclose(np.asarray(arrs["v"]), expect, rtol=1e-6)
+
+
+def test_segment_plan_mean_mesh_executor(monkeypatch):
+    """Same criterion on the MeshExecutor: mean runs as the sharded
+    segment path."""
+    from tensorframes_tpu.parallel.dist import MeshExecutor
+    from tensorframes_tpu.parallel.mesh import data_mesh
+
+    calls = _no_host_spies(monkeypatch, MeshExecutor)
+    rng = np.random.RandomState(22)
+    n = 997  # prime: uneven over the 8-way data axis
+    keys = rng.randint(0, 13, size=n)
+    vals = rng.rand(n, 2)
+    out = tfs.aggregate(
+        lambda v_input: {"v": v_input.mean(0)},
+        tfs.group_by(_frame(keys, vals), "k"),
+        engine=MeshExecutor(data_mesh()),
+    )
+    assert calls["groups"] == 0 and calls["unique"] == 0
+    arrs = out.to_arrays()
+    ks = np.asarray(arrs["k"])
+    expect = np.stack([vals[keys == k].mean(axis=0) for k in ks])
+    np.testing.assert_allclose(np.asarray(arrs["v"]), expect, rtol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "name,prog,oracle",
+    [
+        (
+            "sum_sq",
+            lambda v_input: {"v": (v_input * v_input).sum(0)},
+            lambda g: (g * g).sum(),
+        ),
+        (
+            "scaled_sum",
+            lambda v_input: {"v": v_input.sum(0) * 2.5},
+            lambda g: g.sum() * 2.5,
+        ),
+        (
+            "norm",
+            lambda v_input: {"v": jnp.sqrt((v_input**2).sum(0))},
+            lambda g: np.sqrt((g**2).sum()),
+        ),
+        (
+            "mean_of_squares",
+            lambda v_input: {"v": (v_input**2).mean(0)},
+            lambda g: (g**2).mean(),
+        ),
+        (
+            "variance_form",
+            lambda v_input: {
+                "v": (v_input**2).sum(0) / v_input.shape[0]
+                - (v_input.sum(0) / v_input.shape[0]) ** 2
+            },
+            lambda g: (g**2).mean() - g.mean() ** 2,
+        ),
+        (
+            "unbiased_scale",
+            lambda v_input: {
+                "v": v_input.sum(0) / (v_input.shape[0] - 1)
+            },
+            lambda g: g.sum() / (len(g) - 1),
+        ),
+        (
+            "logsumexp",
+            lambda v_input: {"v": jnp.log(jnp.exp(v_input).sum(0))},
+            lambda g: np.log(np.exp(g).sum()),
+        ),
+        (
+            "min_max_range",
+            lambda v_input: {"v": v_input.max(0) - v_input.min(0)},
+            lambda g: g.max() - g.min(),
+        ),
+    ],
+)
+def test_segment_plan_families(monkeypatch, name, prog, oracle):
+    calls = _no_host_spies(monkeypatch)
+    rng = np.random.RandomState(23)
+    keys = rng.randint(0, 7, size=300)
+    vals = (rng.rand(300) * 2 + 0.5).astype(np.float64)
+    # group sizes >= 2 everywhere is not guaranteed; singleton groups
+    # exercise the count-substitution edge (n-1 == 0 -> inf/nan like the
+    # per-group general path would produce)
+    out = tfs.aggregate(
+        prog, tfs.group_by(_frame(keys, vals), "k")
+    )
+    assert calls["groups"] == 0 and calls["unique"] == 0, name
+    arrs = out.to_arrays()
+    ks = np.asarray(arrs["k"])
+    expect = np.array([oracle(vals[keys == k]) for k in ks])
+    np.testing.assert_allclose(
+        np.asarray(arrs["v"]), expect, rtol=1e-6, equal_nan=True
+    )
+
+
+def test_segment_plan_weighted_sum_cross_column(monkeypatch):
+    """Cross-column row stage: a weighted sum reads BOTH inputs in its
+    pre-reduce computation."""
+    calls = _no_host_spies(monkeypatch)
+    rng = np.random.RandomState(24)
+    keys = rng.randint(0, 6, size=240)
+    v = rng.rand(240)
+    w = rng.rand(240)
+    f = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"k": keys, "v": v, "w": w})
+    )
+    out = tfs.aggregate(
+        lambda v_input, w_input: {
+            "v": (v_input * w_input).sum(0),
+            "w": w_input.sum(0),
+        },
+        tfs.group_by(f, "k"),
+    )
+    assert calls["groups"] == 0 and calls["unique"] == 0
+    arrs = out.to_arrays()
+    ks = np.asarray(arrs["k"])
+    np.testing.assert_allclose(
+        np.asarray(arrs["v"]),
+        np.array([(v[keys == k] * w[keys == k]).sum() for k in ks]),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(arrs["w"]),
+        np.array([w[keys == k].sum() for k in ks]),
+        rtol=1e-6,
+    )
+
+
+def test_segment_plan_matches_general_path_oracle(monkeypatch):
+    """The plan path and the (forced) general path agree bit-for-bit-ish
+    on a mixed program over vector cells.
+
+    Group sizes are kept uniform so the forced general path takes the
+    BUCKETED strategy (runs the program once per whole group — exact for
+    any program).  The skew TREE strategy re-applies the program to its
+    own partials, which the aggregate contract only permits for
+    re-applicable algebraic programs (``Operations.scala:110-126``) —
+    ``mean`` is not one, so it is not a valid oracle there."""
+    rng = np.random.RandomState(25)
+    keys = np.repeat(np.arange(11), 36)
+    rng.shuffle(keys)
+    vals = rng.rand(len(keys), 3)
+    prog = lambda v_input: {"v": v_input.mean(0) * 2.0}
+    fast = tfs.aggregate(prog, tfs.group_by(_frame(keys, vals), "k"))
+    slow_eng = Executor()
+    slow_eng.supports_segment_aggregate = False
+    slow = tfs.aggregate(
+        prog, tfs.group_by(_frame(keys, vals), "k"), engine=slow_eng
+    )
+    fa, sa = fast.to_arrays(), slow.to_arrays()
+    np.testing.assert_array_equal(np.asarray(fa["k"]), np.asarray(sa["k"]))
+    np.testing.assert_allclose(
+        np.asarray(fa["v"]), np.asarray(sa["v"]), rtol=1e-7
+    )
+
+
+def test_segment_plan_count_literal_vs_constant(monkeypatch):
+    """A literal that happens to equal a probe size stays a CONSTANT
+    (2.0 here), while the shape-derived divisor becomes the per-group
+    count — the three-probe trace distinguishes them."""
+    calls = _no_host_spies(monkeypatch)
+    rng = np.random.RandomState(26)
+    keys = rng.randint(0, 5, size=100)
+    vals = rng.rand(100)
+    out = tfs.aggregate(
+        lambda v_input: {"v": (v_input * 2.0).mean(0)},
+        tfs.group_by(_frame(keys, vals), "k"),
+    )
+    assert calls["groups"] == 0 and calls["unique"] == 0
+    arrs = out.to_arrays()
+    ks = np.asarray(arrs["k"])
+    np.testing.assert_allclose(
+        np.asarray(arrs["v"]),
+        np.array([(vals[keys == k] * 2.0).mean() for k in ks]),
+        rtol=1e-6,
+    )
+
+
+def test_segment_plan_count_literal_before_reduce(monkeypatch):
+    """Regression (r5 review): a count literal that appears BEFORE the
+    reduce result inside a post eqn (``n / sum(x)``) must not be resolved
+    during the pre-phase replay (count is only known post-index)."""
+    calls = _no_host_spies(monkeypatch)
+    rng = np.random.RandomState(27)
+    keys = rng.randint(0, 5, size=60)
+    vals = rng.rand(60) + 0.5
+    out = tfs.aggregate(
+        lambda v_input: {"v": v_input.shape[0] / v_input.sum(0)},
+        tfs.group_by(_frame(keys, vals), "k"),
+    )
+    assert calls["groups"] == 0 and calls["unique"] == 0
+    arrs = out.to_arrays()
+    ks = np.asarray(arrs["k"])
+    np.testing.assert_allclose(
+        np.asarray(arrs["v"]),
+        np.array([(keys == k).sum() / vals[keys == k].sum() for k in ks]),
+        rtol=1e-9,
+    )
+
+
+def test_segment_plan_rejects_count_in_row_stage(monkeypatch):
+    """A count-dependent literal inside the ROW stage (``(x * (1/n)).sum``)
+    is rejected — transitively too — and the general path stays exact."""
+    calls = _spy(monkeypatch)
+    rng = np.random.RandomState(28)
+    keys = rng.randint(0, 4, size=48)
+    vals = rng.rand(48)
+    out = tfs.aggregate(
+        lambda v_input: {
+            "v": (v_input * (1.0 / v_input.shape[0])).sum(0)
+        },
+        tfs.group_by(_frame(keys, vals), "k"),
+    )
+    assert calls["n"] >= 1  # general path
+    arrs = out.to_arrays()
+    ks = np.asarray(arrs["k"])
+    np.testing.assert_allclose(
+        np.asarray(arrs["v"]),
+        np.array([vals[keys == k].mean() for k in ks]),
+        rtol=1e-9,
+    )

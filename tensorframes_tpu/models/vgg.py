@@ -1,0 +1,155 @@
+"""VGG-16 image scoring — the reference's literal flagship frozen model.
+
+The reference's headline workload restores a pretrained slim ``vgg_16``
+checkpoint, freezes it into a GraphDef (in-graph bilinear-resize
+preprocessing, conv-implemented fc layers, softmax + top-5), and scores
+image bytes through the verbs
+(``/root/reference/src/main/python/tensorframes_snippets/read_image.py:34-75,108-118``).
+This module is the native jax definition of exactly that network shape:
+
+* slim's conv-fc form — 13 3x3 SAME convs in 5 groups with 2x2 max-pools,
+  then fc6 as a 7x7 VALID conv, fc7/fc8 as 1x1 convs, ``squeeze`` —
+  so the exported GraphDef (``models/vgg_export.py``) is structurally the
+  graph the reference scores, not a dense-layer approximation;
+* preprocessing INSIDE the model (TF-1.x legacy ``ResizeBilinear`` +
+  per-channel mean subtraction, ``vgg_preprocessing``): the same
+  ``graphdef.ops.resize_bilinear`` helper executes in the native path and
+  in the imported-graph path, so export -> import round-trips cannot
+  diverge on resize convention;
+* ``width_mult`` scales every channel count (and the fc width) so CI can
+  exercise the FULL 16-layer op sequence at a tractable parameter count
+  (the architecture, not the width, is what the importer must get right).
+
+NHWC convs on the MXU, f32 accumulation; weights are host numpy until the
+jitted scoring program captures them (zero init-time device dispatches,
+like ``models/inception.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graphdef.ops import resize_bilinear
+
+Params = Dict[str, Any]
+
+NUM_CLASSES = 1000
+INPUT_SIZE = 224  # vgg.vgg_16.default_image_size
+
+# slim vgg_16 channel plan: (name, out_channels, repeats) per conv group;
+# every conv is 3x3 SAME stride 1, every group ends in a 2x2/2 max-pool
+_GROUPS = [
+    ("conv1", 64, 2),
+    ("conv2", 128, 2),
+    ("conv3", 256, 3),
+    ("conv4", 512, 3),
+    ("conv5", 512, 3),
+]
+# fc-as-conv plan: (name, kernel, out_channels, padding)
+_FC = [
+    ("fc6", 7, 4096, "VALID"),
+    ("fc7", 1, 4096, "SAME"),
+    ("fc8", 1, None, "SAME"),  # None -> num_classes (never width-scaled)
+]
+# vgg_preprocessing._mean_image_subtraction constants (RGB)
+MEAN_RGB = (123.68, 116.78, 103.94)
+
+
+def _scaled(ch: int, width_mult: float) -> int:
+    return max(1, int(round(ch * width_mult)))
+
+
+def init(
+    seed: int = 0,
+    width_mult: float = 1.0,
+    num_classes: int = NUM_CLASSES,
+    dtype=np.float32,
+) -> Params:
+    """He-normal random weights in the slim vgg_16 layout (a stand-in for
+    the downloaded ``vgg_16.ckpt`` — the graph structure, not the trained
+    values, is what the GraphDef round-trip validates)."""
+    rng = np.random.RandomState(seed)
+    params: Params = {"convs": [], "fcs": [], "width_mult": width_mult}
+    cin = 3
+    for _name, cout, reps in _GROUPS:
+        group: List[Dict[str, np.ndarray]] = []
+        c = _scaled(cout, width_mult)
+        for _ in range(reps):
+            fan_in = 3 * 3 * cin
+            group.append(
+                {
+                    "w": (
+                        rng.randn(3, 3, cin, c) * np.sqrt(2.0 / fan_in)
+                    ).astype(dtype),
+                    "b": np.zeros((c,), dtype),
+                }
+            )
+            cin = c
+        params["convs"].append(group)
+    for _name, k, cout, _pad in _FC:
+        c = num_classes if cout is None else _scaled(cout, width_mult)
+        fan_in = k * k * cin
+        params["fcs"].append(
+            {
+                "w": (
+                    rng.randn(k, k, cin, c) * np.sqrt(2.0 / fan_in)
+                ).astype(dtype),
+                "b": np.zeros((c,), dtype),
+            }
+        )
+        cin = c
+    return params
+
+
+def _conv(p, x, padding: str, relu: bool = True):
+    y = jax.lax.conv_general_dilated(
+        x,
+        jnp.asarray(p["w"], x.dtype),
+        window_strides=(1, 1),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype) + jnp.asarray(p["b"], x.dtype)
+    return jax.nn.relu(y) if relu else y
+
+
+def apply(params: Params, images, dtype=jnp.float32):
+    """images: [N, H, W, 3] uint8/float -> logits [N, num_classes].
+
+    Preprocessing is part of the model (matching the frozen reference
+    graph): legacy bilinear resize to 224, RGB mean subtraction."""
+    x = resize_bilinear(images, INPUT_SIZE, INPUT_SIZE)
+    x = (x - jnp.asarray(MEAN_RGB, jnp.float32)).astype(dtype)
+    for group in params["convs"]:
+        for p in group:
+            x = _conv(p, x, "SAME")
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+        )
+    for p, (_n, _k, _c, pad), last in zip(
+        params["fcs"], _FC, (False, False, True)
+    ):
+        x = _conv(p, x, pad, relu=not last)
+    return jnp.squeeze(x, axis=(1, 2))
+
+
+def scoring_program(params: Params, dtype=jnp.float32, top_k: int = 5):
+    """Block program: image rows -> top-k ``value``/``index`` + ``probability``
+    of the best class — the reference's fetch set (``read_image.py:70-75``:
+    softmax probabilities + ``top_predictions`` values/indices)."""
+
+    def run(image):
+        logits = apply(params, image, dtype=dtype)
+        probs = jax.nn.softmax(logits, axis=-1)
+        values, indices = jax.lax.top_k(probs, top_k)
+        return {
+            "value": values,
+            "index": indices.astype(jnp.int32),
+            "probability": values[:, 0],
+        }
+
+    return run

@@ -1,0 +1,107 @@
+"""VGG-16 — the reference's literal frozen flagship (read_image.py) —
+exported to real GraphDef bytes and scored through the verbs.
+
+A second full conv-net (after Inception-v3) through the wire codec and
+the importer, with the reference graph's distinctive features the
+Inception path does not exercise: in-graph ResizeBilinear preprocessing
+on variable-size inputs, conv-implemented fc layers with a 7x7 VALID
+kernel, Squeeze, Softmax + TopKV2 heads (VERDICT r4 next #5).
+
+Width-scaled (width_mult=0.25) so CI carries the full 16-layer op
+sequence at ~9M params; the op SEQUENCE (what the importer must lower)
+is identical to the full-width network."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import OpBuilder
+from tensorframes_tpu.graphdef import import_graphdef, load_graphdef
+from tensorframes_tpu.models import vgg
+from tensorframes_tpu.models.vgg_export import export_graphdef
+
+WIDTH = 0.25
+
+
+@pytest.fixture(scope="module")
+def frozen():
+    params = vgg.init(0, width_mult=WIDTH)
+    return params, export_graphdef(params)
+
+
+def test_export_is_real_wire_format(frozen):
+    params, graph_bytes = frozen
+    assert len(graph_bytes) > 1_000_000  # a real multi-MB freeze
+    graph = load_graphdef(graph_bytes)  # full re-parse from bytes
+    ops = {n.op for n in graph.nodes}
+    # the reference graph's vocabulary, incl. what Inception lacks
+    assert {
+        "ResizeBilinear",
+        "Conv2D",
+        "BiasAdd",
+        "Relu",
+        "MaxPool",
+        "Squeeze",
+        "Softmax",
+        "TopKV2",
+    } <= ops
+    n_convs = sum(1 for n in graph.nodes if n.op == "Conv2D")
+    assert n_convs == 16  # 13 convs + fc6/fc7/fc8 as convs: slim vgg_16
+    n_pools = sum(1 for n in graph.nodes if n.op == "MaxPool")
+    assert n_pools == 5
+
+
+def test_frozen_vgg_scores_match_native(frozen):
+    """Import the frozen bytes and score VARIABLE-SIZE images: the
+    in-graph ResizeBilinear (legacy TF-1.x kernel) must reproduce the
+    native path bit-for-bit-ish."""
+    params, graph_bytes = frozen
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, size=(2, 160, 200, 3), dtype=np.uint8)
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"image_data": images})
+    )
+    out = (
+        OpBuilder.map_blocks(frame)
+        .graph(graph_bytes)
+        .fetches(["value", "index", "probability"])
+        .inputs({"image": "image_data"})
+        .build_df()
+    )
+    native = vgg.scoring_program(params)(images)
+    np.testing.assert_array_equal(
+        np.asarray(out.column("index").data), np.asarray(native["index"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.column("value").data),
+        np.asarray(native["value"]),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.column("probability").data),
+        np.asarray(native["probability"]),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+def test_frozen_vgg_analyze_summaries(frozen):
+    _, graph_bytes = frozen
+    program = import_graphdef(
+        graph_bytes, fetches=["value", "index", "probability"]
+    )
+    from tensorframes_tpu import dtypes as dt
+
+    summ = {
+        s.name: s
+        for s in program.analyze(
+            {"image": (dt.by_name("uint8"), (3, 128, 96, 3))}
+        )
+    }
+    assert tuple(summ["value"].shape) == (3, 5)
+    assert tuple(summ["index"].shape) == (3, 5)
+    assert tuple(summ["probability"].shape) == (3,)
+    assert summ["index"].scalar_type.np_dtype == np.int32

@@ -6,8 +6,11 @@ analog here is ``spark.map_blocks``/``spark.aggregate`` over a genuine
 ``local[2]`` SparkSession with an in-process bridge server, exercising
 real ``mapInPandas`` partition functions end to end.
 
-This image cannot host it — the skip below carries the evidence probe
-(run this file to re-check a new image):
+This image cannot host it — the skip below carries the evidence probe,
+and the committed transcript of the full provisioning attempt (apt
+dry-run, pip download, JVM search — all failing) lives in
+``docs/spark_provision_attempt.log`` (re-run this file to re-check a
+new image):
 
 * ``import pyspark`` -> ModuleNotFoundError (not bundled);
 * no JRE: ``which java`` empty, no ``/usr/lib/jvm``;

@@ -1,0 +1,106 @@
+"""Chunked h2d streaming for uncached blocks (VERDICT r4 weak #3).
+
+A big host block is split into row slices, each device_put + dispatched
+separately, so transfer overlaps compute inside the block.  Only
+jaxpr-provably row-independent programs stream (map_rows always: its
+cell program is vmapped, row-independent by construction)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.ops.engine import Executor
+
+
+def _frame(arr, blocks=1):
+    return tfs.analyze(
+        tfs.TensorFrame.from_arrays({"x": arr}, num_blocks=blocks)
+    )
+
+
+def _count_puts(monkeypatch):
+    calls = {"n": 0, "rows": []}
+    orig = jax.device_put
+
+    def spy(arr, *a, **kw):
+        if hasattr(arr, "shape") and np.ndim(arr):
+            calls["n"] += 1
+            calls["rows"].append(np.shape(arr)[0])
+        return orig(arr, *a, **kw)
+
+    monkeypatch.setattr(jax, "device_put", spy)
+    return calls
+
+
+def test_map_blocks_streams_row_independent(monkeypatch):
+    monkeypatch.setattr(Executor, "stream_chunk_bytes", 8 * 1024)
+    x = np.random.RandomState(0).rand(4096, 8)  # 256 KiB f64 -> 32 chunks
+    calls = _count_puts(monkeypatch)
+    out = tfs.map_blocks(lambda x: {"z": jnp.tanh(x) * 2.0}, _frame(x))
+    assert calls["n"] >= 4  # the block really went up in row slices
+    assert sum(calls["rows"]) == 4096
+    np.testing.assert_allclose(
+        np.asarray(out.column("z").data), np.tanh(x) * 2.0, rtol=1e-9
+    )
+
+
+def test_map_blocks_cross_row_does_not_stream(monkeypatch):
+    monkeypatch.setattr(Executor, "stream_chunk_bytes", 8 * 1024)
+    x = np.random.RandomState(1).rand(4096, 8)
+    calls = _count_puts(monkeypatch)
+    out = tfs.map_blocks(lambda x: {"z": x - x.mean(0)}, _frame(x))
+    # one whole-block transfer: chunking would change every output row
+    assert calls["rows"].count(4096) >= 1
+    np.testing.assert_allclose(
+        np.asarray(out.column("z").data), x - x.mean(0), rtol=1e-9
+    )
+
+
+def test_map_rows_streams_by_construction(monkeypatch):
+    monkeypatch.setattr(Executor, "stream_chunk_bytes", 8 * 1024)
+    x = np.random.RandomState(2).rand(4096, 8)
+    calls = _count_puts(monkeypatch)
+    out = tfs.map_rows(lambda x: {"n": (x * x).sum()}, _frame(x))
+    assert calls["n"] >= 4
+    np.testing.assert_allclose(
+        np.asarray(out.column("n").data), (x * x).sum(axis=1), rtol=1e-9
+    )
+
+
+def test_small_blocks_do_not_stream(monkeypatch):
+    calls = _count_puts(monkeypatch)
+    x = np.random.RandomState(3).rand(64, 4)
+    out = tfs.map_blocks(lambda x: {"z": x + 1.0}, _frame(x))
+    assert calls["rows"] == [64]
+    np.testing.assert_allclose(
+        np.asarray(out.column("z").data), x + 1.0, rtol=1e-9
+    )
+
+
+def test_streamed_matches_unstreamed_trimmed(monkeypatch):
+    x = np.random.RandomState(4).rand(2048, 8)
+    ref = tfs.map_blocks_trimmed(lambda x: {"z": jnp.sqrt(x)}, _frame(x))
+    monkeypatch.setattr(Executor, "stream_chunk_bytes", 8 * 1024)
+    streamed = tfs.map_blocks_trimmed(
+        lambda x: {"z": jnp.sqrt(x)}, _frame(x)
+    )
+    np.testing.assert_allclose(
+        np.asarray(streamed.column("z").data),
+        np.asarray(ref.column("z").data),
+        rtol=0,
+    )
+
+
+def test_cached_frames_do_not_stream(monkeypatch):
+    """Device-resident (cached) inputs have nothing to transfer."""
+    monkeypatch.setattr(Executor, "stream_chunk_bytes", 8 * 1024)
+    x = np.random.RandomState(5).rand(4096, 8)
+    f = _frame(x).cache()
+    calls = _count_puts(monkeypatch)
+    out = tfs.map_blocks(lambda x: {"z": x * 3.0}, f)
+    assert calls["n"] == 0  # no h2d at all
+    np.testing.assert_allclose(
+        np.asarray(out.column("z").data), x * 3.0, rtol=1e-9
+    )

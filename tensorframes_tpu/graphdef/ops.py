@@ -541,7 +541,7 @@ REGISTRY: Dict[str, Callable[[List[Any], Dict], Any]] = {
     "DepthToSpace": lambda ins, at: _space_depth(ins, at, to_depth=False),
     "SpaceToDepth": lambda ins, at: _space_depth(ins, at, to_depth=True),
     "InvertPermutation": lambda ins, at: jnp.argsort(ins[0]).astype(
-        np.asarray(ins[0]).dtype
+        ins[0].dtype  # NOT np.asarray(...).dtype: input may be traced
     ),
     "Cumsum": _cum(jnp.cumsum),
     "Cumprod": _cum(jnp.cumprod),

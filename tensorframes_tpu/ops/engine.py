@@ -234,25 +234,20 @@ class Executor:
             )
         if n_rows is None or total < 2 * chunk:
             return None
-        if check_independence:
-            key = (
-                "rowindep",
-                tuple(
-                    sorted(
-                        (n, s.shape, str(s.dtype)) for n, s in specs.items()
-                    )
-                ),
-            )
-            cache = program._derived
-            if key not in cache:
-                cache[key] = segment_compile.is_row_independent(
-                    program, specs
-                )
-            if not cache[key]:
-                return None
         n_chunks = -(-total // chunk)
         per = -(-n_rows // n_chunks)
-        return per if per < n_rows else None
+        if per >= n_rows:
+            return None
+        if check_independence:
+            # verified at the EXACT executed sizes (semantic block size,
+            # chunk size, tail size) — sound against programs whose python
+            # control flow branches on the row count at any threshold
+            tail = n_rows % per or per
+            if not segment_compile.cached_rows_independent(
+                program, specs, (n_rows, per, tail)
+            ):
+                return None
+        return per
 
     def _run_block_streamed(
         self, program: Program, block, infos, per: int, run=None

@@ -194,18 +194,14 @@ class MeshExecutor(Executor):
             specs[name] = jax.ShapeDtypeStruct(
                 (2,) + cell, dtypes.coerce(st).np_dtype
             )
-        key = (
-            "rowindep",
-            tuple(
-                sorted(
-                    (n, s.shape, str(s.dtype)) for n, s in specs.items()
-                )
-            ),
+        # verified at the EXACT sizes involved: the true row count (the
+        # semantics) and the padded count (what executes) — sound against
+        # python control flow branching on the row count at any threshold
+        n = frame.num_rows
+        padded = n + ((-n) % self._num_shards)
+        return segment_compile.cached_rows_independent(
+            program, specs, (n, padded)
         )
-        cache = program._derived
-        if key not in cache:
-            cache[key] = segment_compile.is_row_independent(program, specs)
-        return cache[key]
 
     def _finish_map(
         self, frame: TensorFrame, outs: Dict[str, jnp.ndarray], trim: bool

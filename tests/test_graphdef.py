@@ -692,3 +692,20 @@ def test_elementwise_closure_ops():
         out["at2"], np.arctan2(x, 2.0), rtol=1e-6
     )
     np.testing.assert_allclose(out["cl"], np.clip(x, -1, 1))
+
+
+def test_invert_permutation_traced_input():
+    """Regression (r5 review): InvertPermutation must accept a TRACED
+    permutation (e.g. TopKV2 indices), not just Const-folded ones."""
+    x = np.asarray([[0.3, 0.1, 0.4, 0.2]], np.float32)
+
+    def build(b):
+        b.placeholder("x", "float32", [-1, 4])
+        b.const("k", np.int32(4))
+        b.op("TopKV2", "tk", ["x", "k"])
+        b.op("InvertPermutation", "rank0", ["tk:1"])
+
+    # rank of each element = inverse of the sort permutation
+    out = _run_graph(build, {"x": x}, ["rank0"])
+    np.testing.assert_array_equal(out["rank0"], [[1, 3, 0, 2]])
+    assert out["rank0"].dtype == np.int32

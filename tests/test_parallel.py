@@ -441,3 +441,21 @@ def test_map_blocks_trimmed_row_independent_pad(engine):
     np.testing.assert_allclose(
         np.asarray(out.column("z").data), x * 3.0, rtol=1e-9
     )
+
+
+def test_map_blocks_size_branching_program_not_padded(engine):
+    """Soundness regression (r5 review): a program whose PYTHON control
+    flow branches on the row count above the old probe sizes must not be
+    mistaken for row-independent — the pad+mask proof now traces at the
+    exact semantic and padded sizes."""
+    x = np.arange(997.0)
+    tf = frame({"x": x})
+
+    def prog(x):
+        # elementwise at tiny trace sizes, cross-row at the real one
+        return {"z": x - x.mean() if x.shape[0] > 10 else x}
+
+    out = tfs.map_blocks(prog, tf, engine=engine)
+    np.testing.assert_allclose(
+        np.asarray(out.column("z").data), x - x.mean(), rtol=1e-9
+    )

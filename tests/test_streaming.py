@@ -104,3 +104,20 @@ def test_cached_frames_do_not_stream(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(out.column("z").data), x * 3.0, rtol=1e-9
     )
+
+
+def test_size_branching_program_not_streamed(monkeypatch):
+    """Soundness regression (r5 review): chunked streaming must verify
+    row independence at the EXACT chunk/tail sizes, so a program that is
+    elementwise at small sizes but cross-row at the executed block size
+    keeps whole-block semantics."""
+    monkeypatch.setattr(Executor, "stream_chunk_bytes", 8 * 1024)
+    x = np.random.RandomState(6).rand(4096, 8)
+
+    def prog(x):
+        return {"z": x - x.mean(0) if x.shape[0] > 10 else x}
+
+    out = tfs.map_blocks(prog, _frame(x))
+    np.testing.assert_allclose(
+        np.asarray(out.column("z").data), x - x.mean(0), rtol=1e-9
+    )

@@ -628,6 +628,24 @@ def test_1f1b_activation_memory_bounded(setup):
     assert (g8 - g2) < (p8 - p2), (g2, g8, p2, p8)
 
 
+def test_1f1b_single_stage_fallback_warns(setup, caplog):
+    """pp_stages>1 with no pp mesh axis trains single-stage — but LOUDLY
+    (ADVICE r4: the silent fallback hid a missing jax.set_mesh)."""
+    import logging
+
+    cfg, params, toks, tgts = setup
+    with caplog.at_level(logging.WARNING, "tensorframes_tpu.train"):
+        loss, _g = train.loss_and_grad_1f1b(
+            params, toks, tgts, cfg,
+            train.TrainConfig(pp_stages=2, microbatches=2,
+                              pipeline_schedule="1f1b"),
+        )
+    assert np.isfinite(float(loss))
+    assert any(
+        "SINGLE-stage" in r.message for r in caplog.records
+    ), caplog.records
+
+
 def test_1f1b_validation_errors(setup):
     cfg, params, toks, tgts = setup
     with pytest.raises(ValueError, match="MoE"):

@@ -776,11 +776,14 @@ def bench_inception(jax) -> None:
     wide = _result_for(7)
     if wide is not None:
         result["train_flagship"] = {
-            "config": 7,
-            "tokens_per_s": wide.get("value"),
-            "mfu": wide.get("mfu"),
-            "achieved_tflops": wide.get("achieved_tflops"),
-            "note": wide.get("note"),
+            k: v
+            for k, v in {
+                "config": 7,
+                "tokens_per_s": wide.get("value"),
+                "mfu": wide.get("mfu"),
+                "achieved_tflops": wide.get("achieved_tflops"),
+            }.items()
+            if v is not None
         }
     series = _result_for(6)
     if series is not None:

@@ -1,0 +1,82 @@
+"""The reference's flagship demo, end to end: freeze VGG-16, score bytes.
+
+``/root/reference/src/main/python/tensorframes_snippets/read_image.py``
+builds slim's ``vgg_16`` + in-graph preprocessing + softmax/top-5 heads,
+freezes it with ``convert_variables_to_constants``, re-imports the frozen
+GraphDef, and maps a DataFrame of encoded image bytes through it with
+``tfs.map_rows`` — fetching ``index``/``value`` (top predictions).
+
+This is the same pipeline TPU-native, THROUGH THE FROZEN BYTES (unlike
+``score_images.py``, which scores a native model directly):
+
+* ``models/vgg_export.export_graphdef`` freezes the native VGG-16 into
+  real GraphDef wire bytes (the ``output_graph_def`` of the reference);
+* ``graphdef.import_graphdef`` lowers those bytes back to a device
+  program — Conv2D/MaxPool/ResizeBilinear/TopKV2 through the 127-op
+  registry (``docs/GRAPHDEF_OPS.md``);
+* a ``host_stage`` decodes the binary column (the reference feeds
+  ``DecodeJpeg/contents``; XLA cannot host string tensors, so decode is
+  host work here exactly as the reference's Binary limitation documents);
+* the frozen graph's own ResizeBilinear handles arbitrary input sizes.
+
+Run: ``python examples/score_frozen_vgg.py``  (random weights + random
+"images"; swap ``vgg.init`` for restored weights and ``decode`` for a
+real JPEG codec in a deployment).
+"""
+
+import numpy as np
+
+import _bootstrap  # noqa: F401  (checkout path shim)
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.graphdef import import_graphdef
+from tensorframes_tpu.models import vgg
+from tensorframes_tpu.models.vgg_export import export_graphdef
+
+SIDE = 64  # raw capture size; the frozen graph resizes to 224 in-graph
+
+
+def decode(cells):
+    """Encoded bytes -> [n, SIDE, SIDE, 3] uint8 (stand-in codec)."""
+    return np.stack(
+        [np.frombuffer(c, np.uint8).reshape(SIDE, SIDE, 3) for c in cells]
+    )
+
+
+def main(n_rows: int = 4, width_mult: float = 0.125) -> None:
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, 256, size=(n_rows, SIDE, SIDE, 3), dtype=np.uint8)
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays(
+            {
+                "image_data": [im.tobytes() for im in raw],
+                "uri": [f"img_{i}.raw".encode() for i in range(n_rows)],
+            },
+            num_blocks=2,
+        )
+    )
+
+    # freeze -> wire bytes -> re-import (the reference's round trip)
+    graph_bytes = export_graphdef(vgg.init(0, width_mult=width_mult))
+    print(f"frozen VGG-16 GraphDef: {len(graph_bytes) / 1e6:.1f} MB")
+    program = import_graphdef(
+        graph_bytes,
+        fetches=["index", "value"],
+        inputs={"image": "image_data"},
+    )
+
+    scored = tfs.map_blocks(
+        program, frame, trim=True, host_stage={"image": decode}
+    )
+    idx = np.asarray(scored.column("index").data)
+    val = np.asarray(scored.column("value").data)
+    for i in range(n_rows):
+        top = ", ".join(
+            f"class={int(c)} p={float(p):.3f}"
+            for c, p in zip(idx[i][:3], val[i][:3])
+        )
+        print(f"img_{i}.raw: {top}")
+
+
+if __name__ == "__main__":
+    main()

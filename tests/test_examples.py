@@ -79,3 +79,10 @@ def test_text_lm_example(capsys):
     mod["main"](steps=15, seq_len=16, vocab=300)
     out = capsys.readouterr().out
     assert "BPE:" in out and "'the quick' ->" in out
+
+
+def test_score_frozen_vgg_example(capsys):
+    mod = _run("score_frozen_vgg.py")
+    mod["main"](n_rows=2, width_mult=0.0625)
+    out = capsys.readouterr().out
+    assert "frozen VGG-16 GraphDef" in out and "class=" in out

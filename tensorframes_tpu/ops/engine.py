@@ -126,6 +126,14 @@ class Executor:
         GSPMD-distributed one (SURVEY P5 at mesh scale)."""
         return jnp.asarray(arr)
 
+    def _segment_pad_rows(self, n: int) -> int:
+        """Rows of identity padding the segment-aggregate path should
+        append for a row count of ``n`` — 0 on a single device; the mesh
+        executor pads to a data-axis multiple so uneven frames still
+        shard over the whole mesh (bare-monoid plans only; see
+        ``_aggregate_segment``)."""
+        return 0
+
     # ---------------------------------------------------------------- map --
 
     def _device_value(self, value: Any, st) -> jnp.ndarray:
@@ -908,10 +916,28 @@ class Executor:
         if plan is None:
             return None
 
+        # mesh divisor-cliff fix (round 5): BARE-monoid plans pad the row
+        # axis to a mesh multiple — pad values are the reduction identity
+        # and pad keys copy row 0's key, so no group's result changes and
+        # no group is added (pad iotas sort after every real row, so the
+        # compaction never picks one).  Plans with a pre/post stage cannot
+        # pad safely (mean reads counts; sumsq would square the pad) and
+        # keep the largest-divisor sharding.
+        pad_rows = self._segment_pad_rows(n) if plan.trivial_kinds else 0
+        total = n + pad_rows
+
+        def _pad_tail(arr):
+            if not pad_rows:
+                return arr
+            return jnp.concatenate(
+                [arr, jnp.repeat(arr[:1], pad_rows, axis=0)]
+            )
+
         keys = tuple(
-            self._place_rows(jnp.asarray(kcol.data)) for kcol in kcols
+            self._place_rows(_pad_tail(jnp.asarray(kcol.data)))
+            for kcol in kcols
         )
-        iota = self._place_rows(jnp.arange(n, dtype=jnp.int32))
+        iota = self._place_rows(jnp.arange(total, dtype=jnp.int32))
         # stage 1 (one dispatch): canonicalise + lexicographic sort +
         # segment-id build + group count
         sk, order, gid, newseg, count = _segment_index(keys, iota)
@@ -930,14 +956,23 @@ class Executor:
         # (vmapped), per the program's SegmentPlan (segment_compile.py) —
         # round 5 widens this beyond bare monoids to mean / sum-of-squares
         # / weighted-sum-style affine compositions (VERDICT r4 weak #5)
-        in_cols = {
-            f"{b}_input": self._place_rows(
-                jnp.asarray(frame.column(b).data).astype(
-                    dtypes.coerce(reduced[b].scalar_type).np_dtype
+        in_cols = {}
+        for b in bases:
+            st = dtypes.coerce(reduced[b].scalar_type)
+            arr = jnp.asarray(frame.column(b).data).astype(st.np_dtype)
+            if pad_rows:
+                ident = _monoid_identity(
+                    plan.trivial_kinds[b], st.np_dtype
                 )
-            )
-            for b in bases
-        }
+                arr = jnp.concatenate(
+                    [
+                        arr,
+                        jnp.full(
+                            (pad_rows,) + arr.shape[1:], ident, arr.dtype
+                        ),
+                    ]
+                )
+            in_cols[f"{b}_input"] = self._place_rows(arr)
         sig = tuple(
             (nm, tuple(c.shape), str(c.dtype))
             for nm, c in sorted(in_cols.items())
@@ -1081,6 +1116,23 @@ def _recognize_monoids(
     path)."""
     plan = _recognize_segment_plan(program, reduced, bases)
     return plan.trivial_kinds if plan is not None else None
+
+
+def _monoid_identity(kind: str, dtype) -> np.ndarray:
+    """The reduction identity for one monoid kind at ``dtype`` — the pad
+    value that leaves a group's result unchanged (segment-aggregate mesh
+    padding)."""
+    dt = np.dtype(dtype)
+    if kind == "sum":
+        return np.zeros((), dt)
+    if kind == "prod":
+        return np.ones((), dt)
+    if dt.kind == "f":
+        return np.asarray(np.inf if kind == "min" else -np.inf, dt)
+    if dt.kind == "b":
+        return np.asarray(kind == "min")
+    info = np.iinfo(dt)
+    return np.asarray(info.max if kind == "min" else info.min, dt)
 
 
 # segment-reduction dispatch shared by the plan path (one table: kinds

@@ -63,6 +63,12 @@ class MeshExecutor(Executor):
     # Non-monoid programs keep the groups-axis-sharded general path.
     supports_segment_aggregate = True
 
+    def _segment_pad_rows(self, n: int) -> int:
+        # bare-monoid segment aggregates pad to a data-axis multiple with
+        # reduction identities (engine._aggregate_segment), so uneven row
+        # counts shard over the WHOLE mesh instead of the largest divisor
+        return (-n) % self._num_shards
+
     def _place_rows(self, arr: jnp.ndarray) -> jnp.ndarray:
         # one sharding resolution per row count (several columns share it
         # per aggregate; _shard_for logs on indivisible counts)

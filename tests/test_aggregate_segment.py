@@ -601,3 +601,83 @@ def test_segment_plan_rejects_count_in_row_stage(monkeypatch):
         np.array([vals[keys == k].mean() for k in ks]),
         rtol=1e-9,
     )
+
+
+def test_mesh_segment_monoid_pads_to_full_mesh(monkeypatch):
+    """Round 5: a bare-monoid aggregate on an uneven row count pads with
+    reduction identities to a data-axis multiple and shards over ALL 8
+    devices (previously: largest-divisor fallback — 10 rows ran on 5)."""
+    from tensorframes_tpu.parallel.dist import MeshExecutor
+    from tensorframes_tpu.parallel.mesh import data_mesh
+
+    placed = []
+    orig_place = MeshExecutor._place_rows
+
+    def place_spy(self, arr):
+        out = orig_place(self, arr)
+        placed.append((arr.shape, out.sharding))
+        return out
+
+    monkeypatch.setattr(MeshExecutor, "_place_rows", place_spy)
+    rng = np.random.RandomState(31)
+    n = 10  # 10 % 8 != 0; largest divisor of 8 would be 5
+    keys = rng.randint(0, 4, size=n)
+    v = rng.rand(n)
+    w = rng.randint(-50, 50, size=n)
+    f = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"k": keys, "v": v, "w": w})
+    )
+    out = tfs.aggregate(
+        lambda v_input, w_input: {
+            "v": v_input.sum(0),
+            "w": w_input.min(0),
+        },
+        tfs.group_by(f, "k"),
+        engine=MeshExecutor(data_mesh()),
+    )
+    # every placed row array was padded to 16 and laid out over 8 devices
+    assert placed and all(s[0] == 16 for s, _sh in placed), placed
+    assert all(len(sh.device_set) == 8 for _s, sh in placed), placed
+    arrs = out.to_arrays()
+    ks = np.asarray(arrs["k"])
+    np.testing.assert_array_equal(ks, np.unique(keys))
+    for i, k in enumerate(ks):
+        np.testing.assert_allclose(
+            np.asarray(arrs["v"])[i], v[keys == k].sum(), rtol=1e-9
+        )
+        assert np.asarray(arrs["w"])[i] == w[keys == k].min()
+
+
+def test_mesh_segment_plan_uneven_keeps_divisor_fallback(monkeypatch):
+    """Non-trivial plans (mean: counts) must NOT be identity-padded —
+    padding would inflate the pad-key group's count."""
+    from tensorframes_tpu.parallel.dist import MeshExecutor
+    from tensorframes_tpu.parallel.mesh import data_mesh
+
+    placed = []
+    orig_place = MeshExecutor._place_rows
+
+    def place_spy(self, arr):
+        out = orig_place(self, arr)
+        placed.append(arr.shape)
+        return out
+
+    monkeypatch.setattr(MeshExecutor, "_place_rows", place_spy)
+    rng = np.random.RandomState(32)
+    n = 10
+    keys = rng.randint(0, 4, size=n)
+    v = rng.rand(n)
+    f = _frame(keys, v)
+    out = tfs.aggregate(
+        lambda v_input: {"v": v_input.mean(0)},
+        tfs.group_by(f, "k"),
+        engine=MeshExecutor(data_mesh()),
+    )
+    assert placed and all(s[0] == 10 for s in placed), placed  # unpadded
+    arrs = out.to_arrays()
+    ks = np.asarray(arrs["k"])
+    np.testing.assert_allclose(
+        np.asarray(arrs["v"]),
+        np.array([v[keys == k].mean() for k in ks]),
+        rtol=1e-9,
+    )

@@ -284,6 +284,92 @@ def _space_depth(ins, attrs, to_depth: bool):
     return jnp.reshape(x, (n, h * bs, w * bs, c // (bs * bs)))
 
 
+def _conv2d_backprop_input(ins, attrs):
+    """TF ``Conv2DBackpropInput`` used as a DECONV layer in inference
+    graphs (segmentation/upsampling nets): the gradient of Conv2D w.r.t.
+    its input, applied as a forward op."""
+    out_shape = [int(d) for d in _static(ins[0], "Conv2DBackpropInput "
+                                                  "input_sizes")]
+    w, dy = ins[1], ins[2]  # w: [H, W, Cin, Cout]; dy: [N, Ho, Wo, Cout]
+    strides = [int(s) for s in _attr(attrs, "strides", [1, 1, 1, 1])]
+    padding = _padding_str(attrs)
+    fmt = _str_attr(attrs, "data_format", b"NHWC")
+    if fmt != "NHWC":
+        raise UnsupportedOpError(
+            f"Conv2DBackpropInput data_format {fmt} not supported"
+        )
+    out = lax.conv_transpose(
+        dy,
+        w,
+        strides=tuple(strides[1:3]),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        transpose_kernel=True,
+    )
+    if tuple(out.shape) != tuple(out_shape):
+        raise UnsupportedOpError(
+            f"Conv2DBackpropInput: computed output shape {out.shape} != "
+            f"declared input_sizes {out_shape} (padding/stride combination "
+            f"not representable as a plain conv_transpose)"
+        )
+    return out
+
+
+def _space_to_batch_nd(ins, attrs):
+    x = ins[0]
+    block = [int(b) for b in _static(ins[1], "SpaceToBatchND block_shape")]
+    pads = _static(ins[2], "SpaceToBatchND paddings")
+    pad_width = [(0, 0)] + [
+        (int(a), int(b)) for a, b in pads
+    ] + [(0, 0)] * (x.ndim - 1 - len(block))
+    x = jnp.pad(x, pad_width)
+    n = x.shape[0]
+    spatial = x.shape[1 : 1 + len(block)]
+    rest = x.shape[1 + len(block):]
+    # [N, s1/b1, b1, s2/b2, b2, ..., rest] -> [b1 b2 ... N, s/b..., rest]
+    shape = [n]
+    for s, b in zip(spatial, block):
+        shape += [s // b, b]
+    x = jnp.reshape(x, shape + list(rest))
+    nb = len(block)
+    perm = (
+        [2 * i + 2 for i in range(nb)]
+        + [0]
+        + [2 * i + 1 for i in range(nb)]
+        + list(range(1 + 2 * nb, x.ndim))
+    )
+    x = jnp.transpose(x, perm)
+    out_n = n * int(np.prod(block))
+    return jnp.reshape(
+        x,
+        [out_n] + [s // b for s, b in zip(spatial, block)] + list(rest),
+    )
+
+
+def _batch_to_space_nd(ins, attrs):
+    x = ins[0]
+    block = [int(b) for b in _static(ins[1], "BatchToSpaceND block_shape")]
+    crops = _static(ins[2], "BatchToSpaceND crops")
+    nb = len(block)
+    n = x.shape[0] // int(np.prod(block))
+    spatial = x.shape[1 : 1 + nb]
+    rest = x.shape[1 + nb:]
+    x = jnp.reshape(x, list(block) + [n] + list(spatial) + list(rest))
+    # [b1, b2, N, s1, s2, rest] -> [N, s1, b1, s2, b2, rest]
+    perm = [nb]
+    for i in range(nb):
+        perm += [nb + 1 + i, i]
+    perm += list(range(2 * nb + 1, x.ndim))
+    x = jnp.transpose(x, perm)
+    x = jnp.reshape(
+        x, [n] + [s * b for s, b in zip(spatial, block)] + list(rest)
+    )
+    idx = [slice(None)]
+    for d, (a, b) in enumerate(crops):
+        idx.append(slice(int(a), x.shape[1 + d] - int(b)))
+    return x[tuple(idx)]
+
+
 def _cum(fn):
     def go(ins, attrs):
         axis = int(_static(ins[1], "Cumsum axis"))
@@ -545,6 +631,10 @@ REGISTRY: Dict[str, Callable[[List[Any], Dict], Any]] = {
     ),
     "Cumsum": _cum(jnp.cumsum),
     "Cumprod": _cum(jnp.cumprod),
+    # deconv + dilated-conv plumbing (segmentation/deeplab-style graphs)
+    "Conv2DBackpropInput": _conv2d_backprop_input,
+    "SpaceToBatchND": _space_to_batch_nd,
+    "BatchToSpaceND": _batch_to_space_nd,
     # graph plumbing aliases
     "Snapshot": lambda ins, at: ins[0],
     "PlaceholderWithDefault": lambda ins, at: ins[0],

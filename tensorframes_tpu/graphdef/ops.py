@@ -287,32 +287,47 @@ def _space_depth(ins, attrs, to_depth: bool):
 def _conv2d_backprop_input(ins, attrs):
     """TF ``Conv2DBackpropInput`` used as a DECONV layer in inference
     graphs (segmentation/upsampling nets): the gradient of Conv2D w.r.t.
-    its input, applied as a forward op."""
-    out_shape = [int(d) for d in _static(ins[0], "Conv2DBackpropInput "
-                                                  "input_sizes")]
+    its input, applied as a forward op.
+
+    Lowered in the exact adjoint form — an lhs-dilated conv of the
+    spatially-flipped, channel-swapped kernel with per-edge padding
+    derived from the FORWARD conv's padding — so every ``input_sizes``
+    TF accepts round-trips exactly, including odd SAME shapes with
+    stride 2 (the classic DeepLab 65x65) and dilated kernels."""
+    in_shape = [int(d) for d in _static(ins[0], "Conv2DBackpropInput "
+                                                 "input_sizes")]
     w, dy = ins[1], ins[2]  # w: [H, W, Cin, Cout]; dy: [N, Ho, Wo, Cout]
     strides = [int(s) for s in _attr(attrs, "strides", [1, 1, 1, 1])]
+    dilations = [int(d) for d in _attr(attrs, "dilations", [1, 1, 1, 1])]
     padding = _padding_str(attrs)
     fmt = _str_attr(attrs, "data_format", b"NHWC")
     if fmt != "NHWC":
         raise UnsupportedOpError(
             f"Conv2DBackpropInput data_format {fmt} not supported"
         )
-    out = lax.conv_transpose(
+    pads = []
+    for i in (0, 1):  # spatial dims
+        hi_in, ho = in_shape[1 + i], dy.shape[1 + i]
+        s, d, k = strides[1 + i], dilations[1 + i], w.shape[i]
+        k_eff = (k - 1) * d + 1
+        if padding == "SAME":
+            total = max((ho - 1) * s + k_eff - hi_in, 0)
+            fwd_lo = total // 2
+        else:  # VALID
+            fwd_lo = 0
+        lo = k_eff - 1 - fwd_lo
+        hi = hi_in - 1 - (ho - 1) * s + fwd_lo
+        pads.append((lo, hi))
+    w2 = jnp.flip(jnp.asarray(w), (0, 1)).swapaxes(2, 3)  # [H,W,Cout,Cin]
+    return lax.conv_general_dilated(
         dy,
-        w,
-        strides=tuple(strides[1:3]),
-        padding=padding,
+        w2,
+        window_strides=(1, 1),
+        padding=pads,
+        lhs_dilation=tuple(strides[1:3]),
+        rhs_dilation=tuple(dilations[1:3]),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        transpose_kernel=True,
     )
-    if tuple(out.shape) != tuple(out_shape):
-        raise UnsupportedOpError(
-            f"Conv2DBackpropInput: computed output shape {out.shape} != "
-            f"declared input_sizes {out_shape} (padding/stride combination "
-            f"not representable as a plain conv_transpose)"
-        )
-    return out
 
 
 def _space_to_batch_nd(ins, attrs):

@@ -729,20 +729,81 @@ def test_conv2d_backprop_input_deconv():
 
     out = _run_graph(build, {"dy": dy}, ["dx"])
     assert out["dx"].shape == (1, 8, 8, 2)
-    # oracle: vjp of the forward conv
+    _assert_deconv_matches_vjp(out["dx"], w, dy, (1, 8, 8, 2), (2, 2), "SAME")
+
+
+def _assert_deconv_matches_vjp(dx, w, dy, in_shape, strides, padding, dil=(1, 1)):
+    """Oracle: the vjp of the corresponding forward conv."""
     import jax
     from jax import lax
 
     def fwd(x):
         return lax.conv_general_dilated(
-            x, w, (2, 2), "SAME",
+            x, w, strides, padding, rhs_dilation=dil,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
 
-    x0 = np.zeros((1, 8, 8, 2), np.float32)
+    x0 = np.zeros(in_shape, np.float32)
     _, vjp = jax.vjp(fwd, x0)
     np.testing.assert_allclose(
-        out["dx"], np.asarray(vjp(dy)[0]), rtol=1e-4, atol=1e-5
+        dx, np.asarray(vjp(dy)[0]), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_conv2d_backprop_input_odd_same_and_dilated():
+    """r5 review regressions: odd SAME input sizes (the DeepLab 65x65
+    class — here 9 with stride 2) and dilated deconvs must both lower
+    exactly, not get rejected or silently mis-computed."""
+    rng = np.random.RandomState(2)
+    # odd SAME, stride 2: Hi=9 -> Ho=5
+    w = rng.randn(3, 3, 2, 4).astype(np.float32)
+    dy = rng.randn(1, 5, 5, 4).astype(np.float32)
+
+    def build(b):
+        b.const("sizes", np.asarray([1, 9, 9, 2], np.int32))
+        b.const("w", w)
+        b.placeholder("dy", "float32", [-1, 5, 5, 4])
+        b.op(
+            "Conv2DBackpropInput", "dx", ["sizes", "w", "dy"],
+            strides=[1, 2, 2, 1], padding=b"SAME",
+        )
+
+    out = _run_graph(build, {"dy": dy}, ["dx"])
+    _assert_deconv_matches_vjp(out["dx"], w, dy, (1, 9, 9, 2), (2, 2), "SAME")
+
+    # dilated deconv, stride 1
+    dy2 = rng.randn(1, 8, 8, 4).astype(np.float32)
+
+    def build2(b):
+        b.const("sizes", np.asarray([1, 8, 8, 2], np.int32))
+        b.const("w", w)
+        b.placeholder("dy", "float32", [-1, 8, 8, 4])
+        b.op(
+            "Conv2DBackpropInput", "dx", ["sizes", "w", "dy"],
+            strides=[1, 1, 1, 1], padding=b"SAME",
+            dilations=[1, 2, 2, 1],
+        )
+
+    out2 = _run_graph(build2, {"dy": dy2}, ["dx"])
+    _assert_deconv_matches_vjp(
+        out2["dx"], w, dy2, (1, 8, 8, 2), (1, 1), "SAME", dil=(2, 2)
+    )
+
+    # VALID deconv
+    dy3 = rng.randn(1, 3, 3, 4).astype(np.float32)
+
+    def build3(b):
+        b.const("sizes", np.asarray([1, 7, 7, 2], np.int32))
+        b.const("w", w)
+        b.placeholder("dy", "float32", [-1, 3, 3, 4])
+        b.op(
+            "Conv2DBackpropInput", "dx", ["sizes", "w", "dy"],
+            strides=[1, 2, 2, 1], padding=b"VALID",
+        )
+
+    out3 = _run_graph(build3, {"dy": dy3}, ["dx"])
+    _assert_deconv_matches_vjp(
+        out3["dx"], w, dy3, (1, 7, 7, 2), (2, 2), "VALID"
     )
 
 

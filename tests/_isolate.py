@@ -35,33 +35,47 @@ import sys
 _ENV = "TFS_TEST_ISOLATED"
 
 
-def isolated(fn):
+def isolated(fn, attempts: int = 4):
     test_file = fn.__globals__["__file__"]
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         if os.environ.get(_ENV) == "1":
             return fn(*args, **kwargs)
-        proc = subprocess.run(
-            [
-                sys.executable,
-                "-m",
-                "pytest",
-                f"{test_file}::{fn.__name__}",
-                "-q",
-                "-x",
-                "-p",
-                "no:cacheprovider",
-            ],
-            env={**os.environ, _ENV: "1"},
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            timeout=600,
-        )
+        for attempt in range(attempts):
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    "-m",
+                    "pytest",
+                    f"{test_file}::{fn.__name__}",
+                    "-q",
+                    "-x",
+                    "-p",
+                    "no:cacheprovider",
+                ],
+                env={**os.environ, _ENV: "1"},
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                timeout=600,
+            )
+            if proc.returncode == 0:
+                return
+            # Retry ONLY native deaths (SIGABRT/SIGSEGV-class rcs): the
+            # XLA:CPU collective-permute rendezvous race is timing- and
+            # load-dependent (observed firing ~15-50% under some load
+            # patterns and 0% under others, same binary, same test), so a
+            # crashed attempt says nothing about the numerics the test
+            # exists to pin.  An ORDINARY test failure (rc=1: a tolerance
+            # assertion) is deterministic and must fail immediately —
+            # retrying it would mask real regressions.
+            if proc.returncode == 1:
+                break
         assert proc.returncode == 0, (
             f"isolated test {fn.__name__} failed in its subprocess "
-            f"(rc={proc.returncode}):\n{proc.stdout[-8000:]}"
+            f"(rc={proc.returncode}, "
+            f"{attempt + 1}/{attempts} attempts):\n{proc.stdout[-8000:]}"
         )
 
     return wrapper

@@ -691,12 +691,19 @@ def test_1f1b_composes_with_gspmd_sp(setup):
     GSPMD (auto axes) inside the stage bodies — only the sp-MANUAL ring
     kernels are excluded from this schedule.
 
-    Process-isolated (``_isolate.isolated``): in full-suite position this
-    test SIGABRTed inside XLA:CPU's collective runtime after ~500 prior
-    GSPMD tests while passing in isolation and in every reproducible
-    prefix — an upstream runtime-state fragility, documented in
-    ``tests/_isolate.py`` (VERDICT r4 weak #1)."""
-    cfg, params, toks, tgts = setup
+    Process-isolated (``_isolate.isolated``): this composition trips an
+    XLA:CPU collective-permute rendezvous race whose firing rate is
+    load- and shape-dependent (r4: SIGABRT only after ~500 prior GSPMD
+    tests; r5: measured 15-50% standalone at L=16 and ~20% at L=32 under
+    concurrent load, 0% on a quiet box) — an upstream runtime fragility,
+    documented in ``tests/_isolate.py``.  The test therefore (a) runs in
+    its own interpreter with native-death-only retries (assertion
+    failures still fail fast) and (b) uses L=32 tokens (larger
+    per-device sp chunks narrow the race window; the parity property
+    checked is identical)."""
+    cfg, params, _, _ = setup
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 97)
+    tgts = jnp.roll(toks, -1, axis=1)
     tcfg = train.TrainConfig(
         pp_stages=2, microbatches=4, pipeline_schedule="1f1b"
     )

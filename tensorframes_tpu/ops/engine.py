@@ -202,7 +202,7 @@ class Executor:
     # transfer overlaps chunk k's compute INSIDE the block instead of the
     # whole block's bytes landing before any compute starts.  Applied only
     # to jaxpr-provably row-independent programs (segment_compile.
-    # is_row_independent) — cross-row programs need the whole block.
+    # rows_independent_at) — cross-row programs need the whole block.
     # Tunable: TFS_STREAM_CHUNK_BYTES (0 disables).
     stream_chunk_bytes = int(
         os.environ.get("TFS_STREAM_CHUNK_BYTES", 64 * 1024 * 1024)

@@ -185,7 +185,7 @@ class MeshExecutor(Executor):
     def _pad_safe(self, program, frame, infos, host_stage) -> bool:
         """Whether ``map_blocks`` may pad+mask this program to the mesh
         size: jaxpr-proven row independence (``segment_compile.
-        is_row_independent``), memoized on the Program per input
+        cached_rows_independent``), memoized on the Program per input
         signature.  Host-staged inputs skip the fast path (their cell
         shapes are only known after staging)."""
         if host_stage:

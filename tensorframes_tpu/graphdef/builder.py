@@ -93,4 +93,10 @@ class GraphBuilder:
         return GraphDef(list(self.nodes))
 
     def to_bytes(self) -> bytes:
-        return self.build().encode()
+        """Serialize, with TF-required dtype/count attrs filled in
+        (``tfcompat.complete_for_tf``) so the emitted bytes import into a
+        real TensorFlow, not only into our own importer — the contract the
+        reference's golden tests pin (``ExtractNodes.scala:14-74``)."""
+        from .tfcompat import complete_for_tf
+
+        return complete_for_tf(self.build()).encode()

@@ -263,11 +263,45 @@ def _lrn(ins, attrs):
     return x / (bias + alpha * win) ** beta
 
 
+def _range(ins):
+    # output dtype follows Tidx = the operands' dtype (TF emits int32
+    # Range from int32 starts; numpy's platform default would widen it)
+    start = np.asarray(_static(ins[0], "Range start"))
+    return np.arange(
+        start.item(),
+        np.asarray(_static(ins[1], "Range limit")).item(),
+        np.asarray(_static(ins[2], "Range delta")).item(),
+        dtype=start.dtype,
+    )
+
+
+def _split_v(ins):
+    sizes = np.asarray(
+        _static(ins[1], "SplitV size_splits"), dtype=np.int64
+    ).reshape(-1)
+    axis = int(_static(ins[2], "SplitV axis"))
+    dim = ins[0].shape[axis]
+    neg = np.flatnonzero(sizes < 0)
+    if neg.size > 1:
+        raise UnsupportedOpError(
+            "SplitV size_splits may contain at most one -1"
+        )
+    if neg.size == 1:  # TF's remainder convention: -1 = what's left
+        sizes = sizes.copy()
+        sizes[neg[0]] = dim - (sizes.sum() - sizes[neg[0]])
+    return tuple(jnp.split(ins[0], np.cumsum(sizes[:-1]).tolist(), axis=axis))
+
+
 def _one_hot(ins, attrs):
     indices, depth, on, off = ins
     axis = int(_attr(attrs, "axis", -1))
+    # output dtype is T = on/off_value's dtype (one_hot's own float default
+    # would widen f32 graphs to f64 under x64)
     return jax.nn.one_hot(
-        indices, int(_static(depth, "OneHot depth")), axis=axis
+        indices,
+        int(_static(depth, "OneHot depth")),
+        axis=axis,
+        dtype=jnp.result_type(on),
     ) * (on - off) + off
 
 
@@ -570,11 +604,7 @@ REGISTRY: Dict[str, Callable[[List[Any], Dict], Any]] = {
     "Cast": lambda ins, at: jnp.asarray(ins[0]).astype(
         _np_dtype(at, "DstT")
     ),
-    "Range": lambda ins, at: np.arange(
-        np.asarray(_static(ins[0], "Range start")).item(),
-        np.asarray(_static(ins[1], "Range limit")).item(),
-        np.asarray(_static(ins[2], "Range delta")).item(),
-    ),
+    "Range": lambda ins, at: _range(ins),
     # ---- round 5: TF-1.x inference-closure growth (VERDICT r4 next #5) ----
     # image ops (frozen scoring graphs resize in-graph: read_image.py's
     # vgg_preprocessing -> ResizeBilinear)
@@ -589,15 +619,7 @@ REGISTRY: Dict[str, Callable[[List[Any], Dict], Any]] = {
             axis=int(_static(ins[0], "Split axis")),
         )
     ),
-    "SplitV": lambda ins, at: tuple(
-        jnp.split(
-            ins[0],
-            np.cumsum(
-                _static(ins[1], "SplitV size_splits").reshape(-1)[:-1]
-            ).tolist(),
-            axis=int(_static(ins[2], "SplitV axis")),
-        )
-    ),
+    "SplitV": lambda ins, at: _split_v(ins),
     "TopKV2": lambda ins, at: tuple(
         (v, i.astype(np.int32))
         for v, i in [lax.top_k(ins[0], int(_static(ins[1], "TopKV2 k")))]

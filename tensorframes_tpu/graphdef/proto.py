@@ -229,6 +229,10 @@ class AttrValue:
                         else:
                             items.append(bool(v2))
                     elif f2 == 6:
+                        # list(type) — distinct from list(int): TF's op
+                        # validation rejects the wrong list arm, so the
+                        # kind must survive a parse->encode round trip
+                        kind = "type_list"
                         if wt2 == wire.WIRE_LEN:
                             items.extend(
                                 wire.unpack_packed_varints(v2, signed=False)
@@ -277,6 +281,14 @@ class AttrValue:
                     raise wire.WireError(
                         f"cannot encode list attr item {type(it).__name__}"
                     )
+            wire.write_len_field(out, 1, bytes(lst))
+        elif self.kind == "type_list":
+            # ListValue.type: `repeated DataType type = 6 [packed = true]`
+            packed = bytearray()
+            for en in self.value:
+                wire.write_varint(packed, int(en))
+            lst = bytearray()
+            wire.write_len_field(lst, 6, bytes(packed))
             wire.write_len_field(out, 1, bytes(lst))
         elif self.kind == "none":
             pass

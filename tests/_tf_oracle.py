@@ -1,0 +1,524 @@
+"""Golden-value generator run under REAL TensorFlow in a subprocess.
+
+The reference's cross-implementation guarantee is enforced by spawning a
+real python-TF process and diffing protos/values against it
+(``dsl/ExtractNodes.scala:14-74`` generates a temp ``.py``, runs it via
+``ProcessBuilder("python", ...)``, and parses the printed ``NodeDef``s;
+``.travis.yml:35-37`` installs TF in CI specifically for this).  This
+script is that subprocess: ``tests/test_tf_live.py`` invokes it once per
+session, it builds a battery of graphs with live TF, executes them with a
+TF session, and records ``(graph bytes, inputs, outputs)`` goldens that
+the JAX-side suite then parses, lowers, and matches numerically.
+
+Three golden directions are produced:
+
+* **build cases** — TF constructs + executes op-coverage graphs; the test
+  re-executes them through ``graphdef.import_graphdef`` (read fidelity).
+* **frozen model** — TF builds a variable-bearing CNN and freezes it with
+  ``convert_variables_to_constants`` (the reference's literal flow,
+  ``read_image.py:108-118``), so the importer faces a genuinely
+  TF-generated frozen artifact including variable-read plumbing.
+* **execute jobs** — TF imports graphs OUR writer emitted
+  (``<case>.ours.pb`` + ``<case>.ours.json`` in the work dir) and runs
+  them (write fidelity: real TF accepts and computes our bytes).
+
+Also dumps, for the ``protodiff`` case, each TF-built NodeDef serialized
+deterministically, so the test can byte-compare our writer's encoding
+against TF's own (the "binary identical" bar).
+
+Usage: ``python tests/_tf_oracle.py <workdir>`` (run with real TF
+available; writes ``goldens.json`` + ``.pb``/``.npz`` files into workdir).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+# oneDNN reorders float reductions; keep the oracle numerically vanilla.
+os.environ.setdefault("TF_ENABLE_ONEDNN_OPTS", "0")
+
+import tensorflow as tf  # noqa: E402
+
+tf1 = tf.compat.v1
+tf1.disable_eager_execution()
+
+
+def _rng(seed):
+    return np.random.RandomState(seed)
+
+
+# ---------------------------------------------------------------------------
+# build cases: each returns (feeds: {name: np.ndarray}, fetches: [str])
+# and constructs its graph in the ambient default graph.
+# ---------------------------------------------------------------------------
+
+
+def case_arith():
+    r = _rng(0)
+    a_v = r.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    b_v = r.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    a = tf1.placeholder(tf.float32, [3, 4], name="a")
+    b = tf1.placeholder(tf.float32, [3, 4], name="b")
+    tf.raw_ops.AddV2(x=a, y=b, name="add")
+    tf.raw_ops.Add(x=a, y=b, name="add_v1")
+    tf.raw_ops.Sub(x=a, y=b, name="sub")
+    tf.raw_ops.Mul(x=a, y=b, name="mul")
+    tf.raw_ops.RealDiv(x=a, y=b, name="div")
+    tf.raw_ops.Maximum(x=a, y=b, name="max")
+    tf.raw_ops.Minimum(x=a, y=b, name="min")
+    tf.raw_ops.Pow(x=a, y=b, name="pow")
+    tf.raw_ops.SquaredDifference(x=a, y=b, name="sqdiff")
+    tf.raw_ops.AddN(inputs=[a, b, a], name="addn")
+    tf.raw_ops.Neg(x=a, name="neg")
+    tf.raw_ops.Abs(x=a, name="abs")
+    tf.raw_ops.Sign(x=a, name="sign")
+    tf.raw_ops.Square(x=a, name="square")
+    tf.raw_ops.Reciprocal(x=b, name="recip")
+    tf.raw_ops.Inv(x=b, name="inv")
+    am = tf.raw_ops.Mul(x=a, y=tf.constant(10.0), name="a10")
+    tf.raw_ops.FloorDiv(x=am, y=b, name="floordiv")
+    tf.raw_ops.FloorMod(x=am, y=b, name="floormod")
+    return {"a": a_v, "b": b_v}, [
+        "add", "add_v1", "sub", "mul", "div", "max", "min", "pow",
+        "sqdiff", "addn", "neg", "abs", "sign", "square", "recip",
+        "inv", "floordiv", "floormod",
+    ]
+
+
+def case_mathfns():
+    r = _rng(1)
+    x_v = r.uniform(0.1, 3.0, (2, 8)).astype(np.float32)
+    x = tf1.placeholder(tf.float32, [2, 8], name="x")
+    q = tf.raw_ops.RealDiv(x=x, y=tf.constant(4.0), name="q")  # in (0, .75)
+    for op in ("Exp", "Expm1", "Log", "Log1p", "Sqrt", "Rsqrt", "Erf",
+               "Erfc", "Sin", "Cos", "Tan", "Atan", "Sinh", "Cosh",
+               "Floor", "Ceil", "Round", "Rint"):
+        getattr(tf.raw_ops, op)(x=x, name=op.lower())
+    tf.raw_ops.Asin(x=q, name="asin")
+    tf.raw_ops.Acos(x=q, name="acos")
+    tf.raw_ops.Atan2(y=x, x=q, name="atan2")
+    return {"x": x_v}, [
+        "exp", "expm1", "log", "log1p", "sqrt", "rsqrt", "erf", "erfc",
+        "sin", "cos", "tan", "atan", "sinh", "cosh", "floor", "ceil",
+        "round", "rint", "asin", "acos", "atan2",
+    ]
+
+
+def case_acts():
+    r = _rng(2)
+    x_v = r.uniform(-3.0, 3.0, (4, 5)).astype(np.float32)
+    x = tf1.placeholder(tf.float32, [4, 5], name="x")
+    tf.raw_ops.Relu(features=x, name="relu")
+    tf.raw_ops.Relu6(features=x, name="relu6")
+    tf.raw_ops.Elu(features=x, name="elu")
+    tf.raw_ops.Selu(features=x, name="selu")
+    tf.raw_ops.LeakyRelu(features=x, alpha=0.3, name="leaky")
+    tf.raw_ops.Sigmoid(x=x, name="sigmoid")
+    tf.raw_ops.Tanh(x=x, name="tanh")
+    tf.raw_ops.Softplus(features=x, name="softplus")
+    tf.raw_ops.Softsign(features=x, name="softsign")
+    tf.raw_ops.Softmax(logits=x, name="softmax")
+    tf.raw_ops.LogSoftmax(logits=x, name="logsoftmax")
+    return {"x": x_v}, [
+        "relu", "relu6", "elu", "selu", "leaky", "sigmoid", "tanh",
+        "softplus", "softsign", "softmax", "logsoftmax",
+    ]
+
+
+def case_cmpsel():
+    r = _rng(3)
+    a_v = r.randint(0, 3, (3, 4)).astype(np.float32)
+    b_v = r.randint(0, 3, (3, 4)).astype(np.float32)
+    a = tf1.placeholder(tf.float32, [3, 4], name="a")
+    b = tf1.placeholder(tf.float32, [3, 4], name="b")
+    c = tf.raw_ops.Equal(x=a, y=b, name="eq")
+    tf.raw_ops.NotEqual(x=a, y=b, name="ne")
+    tf.raw_ops.Less(x=a, y=b, name="lt")
+    tf.raw_ops.LessEqual(x=a, y=b, name="le")
+    tf.raw_ops.Greater(x=a, y=b, name="gt")
+    tf.raw_ops.GreaterEqual(x=a, y=b, name="ge")
+    tf.raw_ops.Select(condition=c, x=a, y=b, name="sel")
+    row = tf.raw_ops.Less(x=tf.constant([0.5, 1.5, 0.5, 1.5]), y=tf.constant(1.0))
+    tf.raw_ops.SelectV2(condition=row, t=a, e=b, name="selv2")
+    tf.raw_ops.ClipByValue(
+        t=a, clip_value_min=tf.constant(0.5), clip_value_max=tf.constant(1.5),
+        name="clip")
+    return {"a": a_v, "b": b_v}, [
+        "eq", "ne", "lt", "le", "gt", "ge", "sel", "selv2", "clip",
+    ]
+
+
+def case_linalg():
+    r = _rng(4)
+    a_v = r.randn(3, 4).astype(np.float32)
+    b_v = r.randn(4, 5).astype(np.float32)
+    bm1_v = r.randn(2, 3, 4).astype(np.float32)
+    bm2_v = r.randn(2, 4, 5).astype(np.float32)
+    bmb_v = r.randn(1, 4, 5).astype(np.float32)
+    bias_v = r.randn(5).astype(np.float32)
+    a = tf1.placeholder(tf.float32, [3, 4], name="a")
+    b = tf1.placeholder(tf.float32, [4, 5], name="b")
+    bm1 = tf1.placeholder(tf.float32, [2, 3, 4], name="bm1")
+    bm2 = tf1.placeholder(tf.float32, [2, 4, 5], name="bm2")
+    bmb = tf1.placeholder(tf.float32, [1, 4, 5], name="bmb")
+    bias = tf1.placeholder(tf.float32, [5], name="bias")
+    mm = tf.raw_ops.MatMul(a=a, b=b, name="mm")
+    tf.raw_ops.MatMul(a=a, b=a, transpose_a=True, name="mm_ta")
+    tf.raw_ops.MatMul(a=b, b=b, transpose_b=True, name="mm_tb")
+    tf.raw_ops.BatchMatMul(x=bm1, y=bm2, name="bmm")
+    tf.raw_ops.BatchMatMulV2(x=bm1, y=bm2, name="bmmv2")
+    tf.raw_ops.BatchMatMulV2(x=bm1, y=bmb, name="bmm_bcast")
+    tf.raw_ops.BiasAdd(value=mm, bias=bias, name="biasadd")
+    return {
+        "a": a_v, "b": b_v, "bm1": bm1_v, "bm2": bm2_v, "bmb": bmb_v,
+        "bias": bias_v,
+    }, ["mm", "mm_ta", "mm_tb", "bmm", "bmmv2", "bmm_bcast", "biasadd"]
+
+
+def case_reduce():
+    r = _rng(5)
+    x_v = r.randn(3, 4, 5).astype(np.float32)
+    seg_v = r.randn(6, 3).astype(np.float32)
+    x = tf1.placeholder(tf.float32, [3, 4, 5], name="x")
+    seg = tf1.placeholder(tf.float32, [6, 3], name="seg")
+    ax02 = tf.constant([0, 2], name="ax02")
+    ax1 = tf.constant(1, name="ax1")
+    tf.raw_ops.Sum(input=x, axis=ax02, name="sum")
+    tf.raw_ops.Sum(input=x, axis=ax02, keep_dims=True, name="sum_k")
+    tf.raw_ops.Mean(input=x, axis=ax1, name="mean")
+    tf.raw_ops.Min(input=x, axis=ax1, name="rmin")
+    tf.raw_ops.Max(input=x, axis=ax02, name="rmax")
+    tf.raw_ops.Prod(input=x, axis=ax1, name="prod")
+    gt = tf.raw_ops.Greater(x=x, y=tf.constant(0.0))
+    tf.raw_ops.All(input=gt, axis=ax1, name="all")
+    tf.raw_ops.Any(input=gt, axis=ax1, name="any")
+    tf.raw_ops.ArgMax(input=x, dimension=tf.constant(2), name="argmax")
+    tf.raw_ops.ArgMin(input=x, dimension=tf.constant(1), name="argmin")
+    tf.raw_ops.ArgMax(input=x, dimension=tf.constant(0),
+                      output_type=tf.int32, name="argmax32")
+    tf.raw_ops.Cumsum(x=x, axis=ax1, exclusive=True, name="cumsum_ex")
+    tf.raw_ops.Cumsum(x=x, axis=ax1, reverse=True, name="cumsum_rev")
+    tf.raw_ops.Cumprod(x=x, axis=tf.constant(2), name="cumprod")
+    tf.raw_ops.UnsortedSegmentSum(
+        data=seg, segment_ids=tf.constant([0, 2, 1, 0, 2, 2]),
+        num_segments=tf.constant(4), name="segsum")
+    return {"x": x_v, "seg": seg_v}, [
+        "sum", "sum_k", "mean", "rmin", "rmax", "prod", "all", "any",
+        "argmax", "argmin", "argmax32", "cumsum_ex", "cumsum_rev",
+        "cumprod", "segsum",
+    ]
+
+
+def case_shapes():
+    r = _rng(6)
+    x_v = r.randn(2, 3, 4).astype(np.float32)
+    y_v = r.randn(2, 1, 3, 1).astype(np.float32)
+    row_v = r.randn(1, 4).astype(np.float32)
+    d_v = r.randn(1, 2, 2, 12).astype(np.float32)
+    x = tf1.placeholder(tf.float32, [2, 3, 4], name="x")
+    y = tf1.placeholder(tf.float32, [2, 1, 3, 1], name="y")
+    row = tf1.placeholder(tf.float32, [1, 4], name="row")
+    d = tf1.placeholder(tf.float32, [1, 2, 2, 12], name="d")
+    tf.raw_ops.Reshape(tensor=x, shape=tf.constant([4, 6]), name="reshape")
+    tf.raw_ops.Reshape(tensor=x, shape=tf.constant([-1, 4]), name="reshape_m1")
+    tf.raw_ops.Squeeze(input=y, name="squeeze_all")
+    tf.raw_ops.Squeeze(input=y, axis=[3], name="squeeze_dim")
+    tf.raw_ops.ExpandDims(input=x, axis=tf.constant(-1), name="expand")
+    tf.raw_ops.Transpose(x=x, perm=tf.constant([2, 0, 1]), name="transp")
+    tf.raw_ops.Shape(input=x, name="shape")
+    tf.raw_ops.Rank(input=x, name="rank")
+    tf.raw_ops.Size(input=x, name="size")
+    tf.raw_ops.BroadcastTo(input=row, shape=tf.constant([3, 4]), name="bcast")
+    tf.raw_ops.DepthToSpace(input=d, block_size=2, name="d2s")
+    s2d_in = tf.raw_ops.DepthToSpace(input=d, block_size=2)
+    tf.raw_ops.SpaceToDepth(input=s2d_in, block_size=2, name="s2d")
+    return {"x": x_v, "y": y_v, "row": row_v, "d": d_v}, [
+        "reshape", "reshape_m1", "squeeze_all", "squeeze_dim", "expand",
+        "transp", "shape", "rank", "size", "bcast", "d2s", "s2d",
+    ]
+
+
+def case_slicing():
+    r = _rng(7)
+    x_v = r.randn(4, 5, 6).astype(np.float32)
+    a_v = r.randn(2, 3).astype(np.float32)
+    b_v = r.randn(2, 3).astype(np.float32)
+    x = tf1.placeholder(tf.float32, [4, 5, 6], name="x")
+    a = tf1.placeholder(tf.float32, [2, 3], name="a")
+    b = tf1.placeholder(tf.float32, [2, 3], name="b")
+    tf.raw_ops.ConcatV2(values=[a, b], axis=tf.constant(0), name="concat0")
+    tf.raw_ops.ConcatV2(values=[a, b], axis=tf.constant(-1), name="concat_m1")
+    tf.raw_ops.Concat(concat_dim=tf.constant(1), values=[a, b], name="concat_v1")
+    tf.raw_ops.Pack(values=[a, b], axis=1, name="pack")
+    tf.raw_ops.Unpack(value=a, num=2, axis=0, name="unpack")
+    tf.raw_ops.Split(axis=tf.constant(2), value=x, num_split=2, name="split")
+    tf.raw_ops.SplitV(value=x, size_splits=tf.constant([1, -1, 2]),
+                      axis=tf.constant(1), num_split=3, name="splitv")
+    tf.raw_ops.Slice(input=x, begin=tf.constant([1, 0, 2]),
+                     size=tf.constant([2, -1, 3]), name="slice")
+    # python slicing emits StridedSlice with begin/end/shrink masks
+    tf.identity(x[1:3, ::2, -1], name="ss_shrink")
+    tf.identity(x[::-1], name="ss_revstride")
+    tf.raw_ops.Pad(input=a, paddings=tf.constant([[1, 0], [0, 2]]), name="pad")
+    tf.raw_ops.PadV2(input=a, paddings=tf.constant([[1, 1], [2, 0]]),
+                     constant_values=tf.constant(9.5), name="padv2")
+    tf.raw_ops.Tile(input=a, multiples=tf.constant([2, 3]), name="tile")
+    tf.raw_ops.Gather(params=x, indices=tf.constant([2, 0, 2]), name="gather")
+    tf.raw_ops.GatherV2(params=x, indices=tf.constant([[1, 0], [3, 2]]),
+                        axis=tf.constant(1), name="gatherv2")
+    tf.raw_ops.GatherNd(params=x, indices=tf.constant([[0, 1], [3, 4]]),
+                        name="gathernd")
+    tf.raw_ops.OneHot(indices=tf.constant([0, 2, 4]), depth=tf.constant(5),
+                      on_value=tf.constant(2.0), off_value=tf.constant(-1.0),
+                      name="onehot")
+    flat = tf.raw_ops.Reshape(tensor=x, shape=tf.constant([4, 30]))
+    tf.raw_ops.TopKV2(input=flat, k=tf.constant(3), name="topk")
+    tf.raw_ops.InvertPermutation(x=tf.constant([2, 0, 3, 1]), name="invperm")
+    return {"x": x_v, "a": a_v, "b": b_v}, [
+        "concat0", "concat_m1", "concat_v1", "pack", "unpack:0", "unpack:1",
+        "split:0", "split:1", "splitv:0", "splitv:1", "splitv:2", "slice",
+        "ss_shrink", "ss_revstride", "pad", "padv2", "tile", "gather",
+        "gatherv2", "gathernd", "onehot", "topk:0", "topk:1", "invperm",
+    ]
+
+
+def case_convpool():
+    r = _rng(8)
+    img_v = r.randn(2, 8, 8, 3).astype(np.float32)
+    img = tf1.placeholder(tf.float32, [2, 8, 8, 3], name="img")
+    k = tf.constant(r.randn(3, 3, 3, 4).astype(np.float32) * 0.3, name="k")
+    kd = tf.constant(r.randn(3, 3, 3, 2).astype(np.float32) * 0.3, name="kd")
+    tf.raw_ops.Conv2D(input=img, filter=k, strides=[1, 1, 1, 1],
+                      padding="SAME", name="conv_same")
+    tf.raw_ops.Conv2D(input=img, filter=k, strides=[1, 2, 2, 1],
+                      padding="VALID", name="conv_valid_s2")
+    tf.raw_ops.Conv2D(input=img, filter=k, strides=[1, 1, 1, 1],
+                      padding="SAME", dilations=[1, 2, 2, 1], name="conv_dil")
+    tf.raw_ops.DepthwiseConv2dNative(
+        input=img, filter=kd, strides=[1, 1, 1, 1], padding="SAME",
+        name="dwconv")
+    tf.raw_ops.MaxPool(input=img, ksize=[1, 2, 2, 1], strides=[1, 2, 2, 1],
+                       padding="SAME", name="maxpool")
+    tf.raw_ops.AvgPool(value=img, ksize=[1, 3, 3, 1], strides=[1, 1, 1, 1],
+                       padding="VALID", name="avgpool")
+    scale_v = r.uniform(0.5, 1.5, 3).astype(np.float32)
+    off_v = r.randn(3).astype(np.float32)
+    mean_v = r.randn(3).astype(np.float32)
+    var_v = r.uniform(0.5, 1.5, 3).astype(np.float32)
+    tf.raw_ops.FusedBatchNormV3(
+        x=img, scale=tf.constant(scale_v), offset=tf.constant(off_v),
+        mean=tf.constant(mean_v), variance=tf.constant(var_v),
+        is_training=False, name="fbn3")
+    tf.raw_ops.LRN(input=img, depth_radius=2, bias=1.0, alpha=1e-4,
+                   beta=0.75, name="lrn")
+    small = tf.raw_ops.Conv2D(input=img, filter=k, strides=[1, 2, 2, 1],
+                              padding="SAME")  # [2,4,4,4]
+    tf.raw_ops.Conv2DBackpropInput(
+        input_sizes=tf.constant([2, 8, 8, 3]), filter=k,
+        out_backprop=small, strides=[1, 2, 2, 1], padding="SAME",
+        name="deconv")
+    s2b = tf.raw_ops.SpaceToBatchND(
+        input=img, block_shape=tf.constant([2, 2]),
+        paddings=tf.constant([[0, 0], [0, 0]]), name="s2b")
+    tf.raw_ops.BatchToSpaceND(
+        input=s2b, block_shape=tf.constant([2, 2]),
+        crops=tf.constant([[0, 0], [0, 0]]), name="b2s")
+    sz = tf.constant([5, 5], name="rsz")
+    tf.raw_ops.ResizeBilinear(images=img, size=sz, name="bilinear")
+    tf.raw_ops.ResizeBilinear(images=img, size=sz, align_corners=True,
+                              name="bilinear_ac")
+    tf.raw_ops.ResizeBilinear(images=img, size=sz, half_pixel_centers=True,
+                              name="bilinear_hp")
+    tf.raw_ops.ResizeNearestNeighbor(images=img, size=sz, name="nearest")
+    return {"img": img_v}, [
+        "conv_same", "conv_valid_s2", "conv_dil", "dwconv", "maxpool",
+        "avgpool", "fbn3:0", "lrn", "deconv", "s2b", "b2s", "bilinear",
+        "bilinear_ac", "bilinear_hp", "nearest",
+    ]
+
+
+def case_gencast():
+    r = _rng(9)
+    x_v = (r.randn(2, 3) * 3).astype(np.float32)
+    u_v = r.randint(0, 255, (2, 3)).astype(np.uint8)
+    x = tf1.placeholder(tf.float32, [2, 3], name="x")
+    u = tf1.placeholder(tf.uint8, [2, 3], name="u")
+    tf.raw_ops.Fill(dims=tf.constant([2, 3]), value=tf.constant(7.5),
+                    name="fill")
+    tf.raw_ops.Range(start=tf.constant(2), limit=tf.constant(18),
+                     delta=tf.constant(3), name="range")
+    tf.raw_ops.ZerosLike(x=x, name="zeros_like")
+    tf.raw_ops.OnesLike(x=x, name="ones_like")
+    tf.raw_ops.Cast(x=x, DstT=tf.int32, name="cast_i32")
+    tf.raw_ops.Cast(x=x, DstT=tf.float64, name="cast_f64")
+    tf.raw_ops.Cast(x=u, DstT=tf.float32, name="cast_u8_f32")
+    tf.constant(np.array([[1.5, -2.5]], np.float64), name="c_f64")
+    tf.constant(np.array([7, -9], np.int64), name="c_i64")
+    tf.constant(np.array([True, False, True]), name="c_bool")
+    tf.constant(np.array([250, 3], np.uint8), name="c_u8")
+    tf.constant(np.arange(6, dtype=np.int32).reshape(2, 3), name="c_i32")
+    return {"x": x_v, "u": u_v}, [
+        "fill", "range", "zeros_like", "ones_like", "cast_i32", "cast_f64",
+        "cast_u8_f32", "c_f64", "c_i64", "c_bool", "c_u8", "c_i32",
+    ]
+
+
+BUILD_CASES = {
+    "arith": case_arith,
+    "mathfns": case_mathfns,
+    "acts": case_acts,
+    "cmpsel": case_cmpsel,
+    "linalg": case_linalg,
+    "reduce": case_reduce,
+    "shapes": case_shapes,
+    "slicing": case_slicing,
+    "convpool": case_convpool,
+    "gencast": case_gencast,
+}
+
+
+def build_frozen_cnn(workdir):
+    """A variable-bearing CNN frozen by TF itself — the reference's
+    ``convert_variables_to_constants`` flow (``read_image.py:108-118``)."""
+    r = _rng(42)
+    img_v = r.randint(0, 255, (3, 12, 12, 3)).astype(np.uint8)
+    g = tf1.Graph()
+    with g.as_default():
+        img = tf1.placeholder(tf.uint8, [None, 12, 12, 3], name="image")
+        xf = tf.cast(img, tf.float32)
+        x = tf.raw_ops.ResizeBilinear(images=xf, size=tf.constant([8, 8]))
+        w1 = tf1.get_variable(
+            "w1", initializer=(r.randn(3, 3, 3, 8) * 0.2).astype(np.float32))
+        b1 = tf1.get_variable("b1", initializer=np.zeros(8, np.float32))
+        y = tf.nn.conv2d(x, w1, strides=[1, 1, 1, 1], padding="SAME")
+        y = tf.nn.bias_add(y, b1)
+        # frozen-inference batch norm (FusedBatchNorm with constant stats)
+        scale = tf1.get_variable(
+            "bn_scale", initializer=r.uniform(0.5, 1.5, 8).astype(np.float32))
+        offset = tf1.get_variable(
+            "bn_off", initializer=r.randn(8).astype(np.float32) * 0.1)
+        mean = tf1.get_variable(
+            "bn_mean", initializer=r.randn(8).astype(np.float32) * 0.1)
+        var = tf1.get_variable(
+            "bn_var", initializer=r.uniform(0.8, 1.2, 8).astype(np.float32))
+        y, _, _, _, _, _ = tf.raw_ops.FusedBatchNormV3(
+            x=y, scale=scale, offset=offset, mean=mean, variance=var,
+            is_training=False)
+        y = tf.nn.relu(y)
+        y = tf.nn.max_pool2d(y, ksize=2, strides=2, padding="SAME")
+        w2 = tf1.get_variable(
+            "w2", initializer=(r.randn(3, 3, 8, 16) * 0.2).astype(np.float32))
+        y = tf.nn.conv2d(y, w2, strides=[1, 1, 1, 1], padding="VALID")
+        y = tf.nn.relu(y)
+        y = tf.reshape(y, [-1, 2 * 2 * 16])
+        wd = tf1.get_variable(
+            "wd", initializer=(r.randn(64, 10) * 0.3).astype(np.float32))
+        bd = tf1.get_variable("bd", initializer=np.zeros(10, np.float32))
+        logits = tf.nn.bias_add(tf.matmul(y, wd), bd)
+        probs = tf.nn.softmax(logits, name="probability")
+        tf.raw_ops.TopKV2(input=probs, k=tf.constant(3), name="top")
+        with tf1.Session() as sess:
+            sess.run(tf1.global_variables_initializer())
+            frozen = tf1.graph_util.convert_variables_to_constants(
+                sess, g.as_graph_def(), ["probability", "top"])
+            outs = sess.run(["probability:0", "top:0", "top:1"],
+                            {"image:0": img_v})
+    with open(os.path.join(workdir, "frozen_cnn.pb"), "wb") as f:
+        f.write(frozen.SerializeToString())
+    arrays = {"in__image": img_v}
+    for ref, val in zip(["probability:0", "top:0", "top:1"], outs):
+        arrays["out__" + ref.replace(":", "__")] = val
+    np.savez(os.path.join(workdir, "frozen_cnn.npz"), **arrays)
+    return {
+        "pb": "frozen_cnn.pb", "npz": "frozen_cnn.npz",
+        "feeds": ["image"], "fetches": ["probability:0", "top:0", "top:1"],
+    }
+
+
+def build_protodiff(workdir):
+    """The byte-level proto diff case (``ExtractNodes.scala`` discipline):
+    TF builds the canonical tiny graph; each NodeDef is serialized
+    deterministically for byte comparison against our writer."""
+    g = tf1.Graph()
+    with g.as_default():
+        x = tf1.placeholder(tf.float32, [2, 2], name="x")
+        c = tf.constant(np.array([[3.0, 3.0]], np.float32), name="matrix1")
+        s = tf.raw_ops.Add(x=x, y=c, name="out")
+        tf.raw_ops.Identity(input=s, name="ident")
+    gd = g.as_graph_def()
+    nodes = {}
+    for node in gd.node:
+        nodes[node.name] = node.SerializeToString(deterministic=True).hex()
+    with open(os.path.join(workdir, "protodiff_nodes.json"), "w") as f:
+        json.dump(nodes, f)
+    with open(os.path.join(workdir, "protodiff.pb"), "wb") as f:
+        f.write(gd.SerializeToString())
+    return {"nodes": "protodiff_nodes.json", "pb": "protodiff.pb"}
+
+
+def run_build_case(name, fn, workdir):
+    g = tf1.Graph()
+    with g.as_default():
+        feeds, fetches = fn()
+        with tf1.Session() as sess:
+            outs = sess.run(
+                [f if ":" in f else f + ":0" for f in fetches],
+                {k + ":0": v for k, v in feeds.items()})
+    with open(os.path.join(workdir, name + ".pb"), "wb") as f:
+        f.write(g.as_graph_def().SerializeToString())
+    arrays = {}
+    for k, v in feeds.items():
+        arrays["in__" + k] = v
+    for ref, val in zip(fetches, outs):
+        arrays["out__" + ref.replace(":", "__")] = val
+    np.savez(os.path.join(workdir, name + ".npz"), **arrays)
+    return {
+        "pb": name + ".pb", "npz": name + ".npz",
+        "feeds": sorted(feeds), "fetches": list(fetches),
+    }
+
+
+def run_ours_job(spec, workdir):
+    """Write-fidelity leg: real TF imports OUR serialized GraphDef and
+    executes it (proves TF accepts the bytes AND agrees numerically)."""
+    with open(os.path.join(workdir, spec["pb"]), "rb") as f:
+        gd = tf1.GraphDef.FromString(f.read())
+    data = np.load(os.path.join(workdir, spec["npz"]))
+    g = tf1.Graph()
+    with g.as_default():
+        tf1.import_graph_def(gd, name="")
+        with tf1.Session() as sess:
+            outs = sess.run(
+                [f if ":" in f else f + ":0" for f in spec["fetches"]],
+                {k + ":0": data["in__" + k] for k in spec["feeds"]})
+    arrays = {}
+    for ref, val in zip(spec["fetches"], outs):
+        arrays["out__" + ref.replace(":", "__")] = val
+    out_name = spec["name"] + ".tfout.npz"
+    np.savez(os.path.join(workdir, out_name), **arrays)
+    return {"npz": out_name, "fetches": spec["fetches"]}
+
+
+def main():
+    workdir = sys.argv[1]
+    manifest = {"tf_version": tf.__version__, "build": {}, "ours": {}}
+    for name, fn in BUILD_CASES.items():
+        manifest["build"][name] = run_build_case(name, fn, workdir)
+    manifest["frozen_cnn"] = build_frozen_cnn(workdir)
+    manifest["protodiff"] = build_protodiff(workdir)
+    jobs_path = os.path.join(workdir, "ours_jobs.json")
+    if os.path.exists(jobs_path):
+        with open(jobs_path) as f:
+            jobs = json.load(f)
+        for spec in jobs:
+            manifest["ours"][spec["name"]] = run_ours_job(spec, workdir)
+    with open(os.path.join(workdir, "goldens.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    print("tf-oracle: ok")
+
+
+if __name__ == "__main__":
+    main()

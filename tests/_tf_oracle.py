@@ -366,6 +366,29 @@ def case_gencast():
     ]
 
 
+def case_plumbing():
+    r = _rng(10)
+    x_v = r.randn(2, 3).astype(np.float32)
+    x = tf1.placeholder(tf.float32, [2, 3], name="x")
+    tf.raw_ops.Identity(input=x, name="ident")
+    tf.raw_ops.Snapshot(input=x, name="snap")
+    tf.raw_ops.StopGradient(input=x, name="stopg")
+    tf.raw_ops.PreventGradient(input=x, name="prevg")
+    tf.raw_ops.CheckNumerics(tensor=x, message="oracle", name="checknum")
+    d = tf.constant(np.full((2, 3), 7.0, np.float32))
+    tf.raw_ops.PlaceholderWithDefault(input=d, shape=[2, 3], name="phd")
+    idn = tf.raw_ops.IdentityN(input=[x, tf.constant([1, 2], tf.int32)],
+                               name="idn")
+    # a control-dependency edge (freezing leaves these behind when it
+    # strips Assert/initializer nodes)
+    with tf1.control_dependencies([idn[0]]):
+        tf.raw_ops.Mul(x=x, y=tf.constant(2.0), name="ctrl_mul")
+    return {"x": x_v}, [
+        "ident", "snap", "stopg", "prevg", "checknum", "phd",
+        "idn:0", "idn:1", "ctrl_mul",
+    ]
+
+
 BUILD_CASES = {
     "arith": case_arith,
     "mathfns": case_mathfns,
@@ -377,6 +400,7 @@ BUILD_CASES = {
     "slicing": case_slicing,
     "convpool": case_convpool,
     "gencast": case_gencast,
+    "plumbing": case_plumbing,
 }
 
 

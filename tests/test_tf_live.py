@@ -15,7 +15,8 @@ whenever a TensorFlow install is present (they skip cleanly otherwise):
   ``read_image.py:108-118``); the genuinely TF-generated artifact must
   score identically here.
 * **write fidelity** — real TF imports graphs OUR writer emitted (the
-  VGG-16 exporter + the DSL), executes them, and must agree with the
+  VGG-16 exporter + the DSL; the full Inception-v3 export too when
+  ``TFS_TF_LIVE_HEAVY=1``), executes them, and must agree with the
   native model — plus a byte-level NodeDef diff against TF's own
   deterministic serialization (the "binary identical" bar).
 """
@@ -47,7 +48,7 @@ _ORACLE = os.path.join(os.path.dirname(__file__), "_tf_oracle.py")
 # it would pull TF into this process); test_oracle_case_list pins the sync
 BUILD_CASE_NAMES = [
     "arith", "mathfns", "acts", "cmpsel", "linalg",
-    "reduce", "shapes", "slicing", "convpool", "gencast",
+    "reduce", "shapes", "slicing", "convpool", "gencast", "plumbing",
 ]
 # float comparison tolerance per case (ints/bools are always exact)
 _TOL = {
@@ -63,6 +64,11 @@ _VGG_WIDTH = 0.25
 def _vgg_image():
     return np.random.RandomState(7).randint(
         0, 255, (2, 40, 40, 3)).astype(np.uint8)
+
+
+def _inception_image():
+    return np.random.RandomState(13).randint(
+        0, 255, (1, 299, 299, 3)).astype(np.uint8)
 
 
 def _dsl_fetches():
@@ -94,6 +100,21 @@ def tf_goldens(tmp_path_factory):
         "name": "dsl_pipe", "pb": "dsl_pipe.pb", "npz": "dsl_pipe.npz",
         "feeds": ["x"], "fetches": ["y", "z"],
     })
+
+    if os.environ.get("TFS_TF_LIVE_HEAVY") == "1":
+        # full-size Inception-v3 (no reduced form exists): ~95 MB of
+        # bytes through TF import — opt-in so the default suite stays fast
+        from tensorframes_tpu.models import inception, inception_export
+
+        iparams = inception.init(0, dtype=np.float32)
+        (wd / "inception.pb").write_bytes(
+            inception_export.export_graphdef(iparams))
+        np.savez(wd / "inception.npz", in__image=_inception_image())
+        jobs.append({
+            "name": "inception", "pb": "inception.pb",
+            "npz": "inception.npz", "feeds": ["image"],
+            "fetches": ["prediction", "score"],
+        })
     (wd / "ours_jobs.json").write_text(json.dumps(jobs))
 
     proc = subprocess.run(
@@ -215,6 +236,28 @@ def test_tf_executes_our_dsl_graph(tf_goldens):
         np.asarray(ours["y"]), tf_out["out__y"], rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(
         np.asarray(ours["z"]), tf_out["out__z"], rtol=1e-5, atol=1e-6)
+
+
+def test_tf_executes_our_inception_export(tf_goldens):
+    """Opt-in (TFS_TF_LIVE_HEAVY=1) model-scale write fidelity on the
+    second conv flagship: real TF runs our full Inception-v3 bytes
+    (FusedBatchNorm / ConcatV2 / AvgPool vocabulary) and must agree with
+    the native scoring program on class and score."""
+    wd, manifest = tf_goldens
+    if "inception" not in manifest["ours"]:
+        pytest.skip("heavy TF job disabled (set TFS_TF_LIVE_HEAVY=1)")
+    from tensorframes_tpu.models import inception
+
+    job = manifest["ours"]["inception"]
+    tf_out = np.load(wd / job["npz"])
+    iparams = inception.init(0, dtype=np.float32)
+    run = inception.scoring_program(iparams, dtype=np.float32)
+    native = run(_inception_image())
+    np.testing.assert_array_equal(
+        np.asarray(native["prediction"]), tf_out["out__prediction"])
+    np.testing.assert_allclose(
+        np.asarray(native["score"]), tf_out["out__score"],
+        rtol=2e-2, atol=1e-4)
 
 
 def _protodiff_ours():

@@ -233,6 +233,35 @@ class TensorFrame:
         return TensorFrame(cols).repartition(num_blocks)
 
     @staticmethod
+    def from_arrow(table, num_blocks: int = 1) -> "TensorFrame":
+        """Arrow Table -> frame, zero-copy where the layout allows
+        (:mod:`tensorframes_tpu.io`; SURVEY.md §7's columnar ingest)."""
+        from .io import table_to_frame
+
+        return table_to_frame(table, num_blocks=num_blocks)
+
+    def to_arrow(self):
+        """Frame -> Arrow Table (inverse of :meth:`from_arrow`)."""
+        from .io import frame_to_table
+
+        return frame_to_table(self)
+
+    @staticmethod
+    def from_parquet(
+        path, columns=None, num_blocks: int = 1
+    ) -> "TensorFrame":
+        """Read a parquet file/dir — the storage behind the reference's
+        Spark DataFrames — straight into columnar frame storage."""
+        from .io import read_parquet
+
+        return read_parquet(path, columns=columns, num_blocks=num_blocks)
+
+    def to_parquet(self, path) -> None:
+        from .io import write_parquet
+
+        write_parquet(self, path)
+
+    @staticmethod
     def from_pandas(df, num_blocks: int = 1) -> "TensorFrame":
         data = {}
         for name in df.columns:

@@ -72,8 +72,9 @@ def _pool(x, attrs, reducer, init, avg=False):
     ksize = [int(k) for k in _attr(attrs, "ksize")]
     strides = [int(s) for s in _attr(attrs, "strides")]
     padding = _padding_str(attrs)
-    fmt = _str_attr(attrs, "data_format", b"NHWC")
-    if fmt != "NHWC":
+    default_fmt = b"NDHWC" if len(ksize) == 5 else b"NHWC"
+    fmt = _str_attr(attrs, "data_format", default_fmt)
+    if fmt not in ("NHWC", "NDHWC"):
         raise UnsupportedOpError(f"pooling data_format {fmt} not supported")
     out = lax.reduce_window(
         x, init, reducer, tuple(ksize), tuple(strides), padding
@@ -102,6 +103,41 @@ def _conv2d(ins, attrs):
         padding=padding,
         rhs_dilation=dilations[1:3],
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _conv3d(ins, attrs):
+    # the gap-table promise (docs/GRAPHDEF_OPS.md): same lowering as
+    # Conv2D with three spatial dims
+    x, w = ins
+    strides = [int(s) for s in _attr(attrs, "strides", [1] * 5)]
+    dilations = [int(d) for d in _attr(attrs, "dilations", [1] * 5)]
+    padding = _padding_str(attrs)
+    fmt = _str_attr(attrs, "data_format", b"NDHWC")
+    if fmt != "NDHWC":
+        raise UnsupportedOpError(f"Conv3D data_format {fmt} not supported")
+    return lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides[1:4],
+        padding=padding,
+        rhs_dilation=dilations[1:4],
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+    )
+
+
+def _mirror_pad(ins, attrs):
+    x, pads = ins
+    mode = _str_attr(attrs, "mode", b"REFLECT")
+    if mode not in ("REFLECT", "SYMMETRIC"):
+        raise UnsupportedOpError(f"MirrorPad mode {mode} not supported")
+    pads = np.asarray(_static(pads, "MirrorPad paddings")).astype(int)
+    return jnp.pad(
+        x,
+        [(int(a), int(b)) for a, b in pads],
+        # numpy "reflect" excludes the edge (TF REFLECT); "symmetric"
+        # repeats it (TF SYMMETRIC)
+        mode="reflect" if mode == "REFLECT" else "symmetric",
     )
 
 
@@ -518,6 +554,10 @@ REGISTRY: Dict[str, Callable[[List[Any], Dict], Any]] = {
     "DepthwiseConv2dNative": _depthwise_conv2d,
     "MaxPool": lambda ins, at: _pool(ins[0], at, lax.max, -jnp.inf),
     "AvgPool": lambda ins, at: _pool(ins[0], at, lax.add, 0.0, avg=True),
+    "Conv3D": _conv3d,
+    "MaxPool3D": lambda ins, at: _pool(ins[0], at, lax.max, -jnp.inf),
+    "AvgPool3D": lambda ins, at: _pool(ins[0], at, lax.add, 0.0, avg=True),
+    "MirrorPad": _mirror_pad,
     "FusedBatchNorm": _fused_batch_norm,
     "FusedBatchNormV2": _fused_batch_norm,
     "FusedBatchNormV3": _fused_batch_norm,

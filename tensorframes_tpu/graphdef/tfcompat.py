@@ -40,7 +40,8 @@ _PASS_T = frozenset(
     OnesLike LRN MaxPool AvgPool BiasAdd ClipByValue InvertPermutation
     CheckNumerics Add AddV2 Sub Mul Div RealDiv FloorDiv FloorMod Mod
     Maximum Minimum Pow SquaredDifference Atan2 MatMul BatchMatMul
-    BatchMatMulV2 Conv2D DepthwiseConv2dNative DepthToSpace SpaceToDepth
+    BatchMatMulV2 Conv2D Conv3D DepthwiseConv2dNative MaxPool3D
+    AvgPool3D DepthToSpace SpaceToDepth
     ResizeNearestNeighbor""".split()
 )
 _CMP = frozenset(
@@ -57,6 +58,7 @@ _IDX_PAIR = {
     "StridedSlice": ("T", "Index"),
     "Pad": ("T", "Tpaddings"),
     "PadV2": ("T", "Tpaddings"),
+    "MirrorPad": ("T", "Tpaddings"),
     "Tile": ("T", "Tmultiples"),
     "Gather": ("Tparams", "Tindices"),
     "GatherNd": ("Tparams", "Tindices"),

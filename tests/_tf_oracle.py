@@ -326,6 +326,30 @@ def case_convpool():
     tf.raw_ops.BatchToSpaceND(
         input=s2b, block_shape=tf.constant([2, 2]),
         crops=tf.constant([[0, 0], [0, 0]]), name="b2s")
+    vol = tf1.placeholder(tf.float32, [1, 4, 6, 6, 2], name="vol")
+    k3 = tf.constant(r.randn(2, 3, 3, 2, 4).astype(np.float32) * 0.3)
+    tf.raw_ops.Conv3D(input=vol, filter=k3, strides=[1, 1, 1, 1, 1],
+                      padding="SAME", name="conv3d")
+    tf.raw_ops.Conv3D(input=vol, filter=k3, strides=[1, 1, 2, 2, 1],
+                      padding="VALID", name="conv3d_s2")
+    tf.raw_ops.MaxPool3D(input=vol, ksize=[1, 2, 2, 2, 1],
+                         strides=[1, 2, 2, 2, 1], padding="SAME",
+                         name="maxpool3d")
+    tf.raw_ops.AvgPool3D(input=vol, ksize=[1, 2, 2, 2, 1],
+                         strides=[1, 1, 1, 1, 1], padding="VALID",
+                         name="avgpool3d")
+    # SAME padding is where TF's exclude-padding average divisor differs
+    # from a naive constant-divisor lowering
+    tf.raw_ops.AvgPool3D(input=vol, ksize=[1, 3, 3, 3, 1],
+                         strides=[1, 2, 2, 2, 1], padding="SAME",
+                         name="avgpool3d_same")
+    # (dilated Conv3D omitted: TF's own CPU kernel rejects dilation > 1,
+    # so no golden can be produced)
+    mp = tf.constant([[0, 0], [1, 2], [2, 1], [0, 0]])
+    tf.raw_ops.MirrorPad(input=img, paddings=mp, mode="REFLECT",
+                         name="mirror_ref")
+    tf.raw_ops.MirrorPad(input=img, paddings=mp, mode="SYMMETRIC",
+                         name="mirror_sym")
     sz = tf.constant([5, 5], name="rsz")
     tf.raw_ops.ResizeBilinear(images=img, size=sz, name="bilinear")
     tf.raw_ops.ResizeBilinear(images=img, size=sz, align_corners=True,
@@ -333,10 +357,13 @@ def case_convpool():
     tf.raw_ops.ResizeBilinear(images=img, size=sz, half_pixel_centers=True,
                               name="bilinear_hp")
     tf.raw_ops.ResizeNearestNeighbor(images=img, size=sz, name="nearest")
-    return {"img": img_v}, [
+    vol_v = r.randn(1, 4, 6, 6, 2).astype(np.float32)
+    return {"img": img_v, "vol": vol_v}, [
         "conv_same", "conv_valid_s2", "conv_dil", "dwconv", "maxpool",
-        "avgpool", "fbn3:0", "lrn", "deconv", "s2b", "b2s", "bilinear",
-        "bilinear_ac", "bilinear_hp", "nearest",
+        "avgpool", "fbn3:0", "lrn", "deconv", "s2b", "b2s", "conv3d",
+        "conv3d_s2", "maxpool3d", "avgpool3d", "avgpool3d_same",
+        "mirror_ref", "mirror_sym",
+        "bilinear", "bilinear_ac", "bilinear_hp", "nearest",
     ]
 
 

@@ -1,0 +1,88 @@
+"""The reference's literal JPEG-scoring call shape: DecodeJpeg in-graph.
+
+``read_image.py:120-167`` maps a DataFrame of ENCODED jpeg bytes through
+a frozen VGG-16 whose graph starts at a ``DecodeJpeg`` node, feeding
+``{'DecodeJpeg/contents': 'image_data'}`` — no decode code on the user
+side.  This example reproduces that exact shape TPU-natively:
+
+* the frozen graph carries ``DecodeJpeg`` + ``ExpandDims`` in front of
+  the VGG stack (built here by composing the VGG exporter's bytes with a
+  decode front-end);
+* ``import_graphdef`` detects the decode node and attaches a PIL-backed
+  host prelude to the program — XLA never sees string tensors;
+* ``tfs.map_rows`` with ``feed_dict`` is the whole user call, exactly as
+  in the reference.
+
+Run: ``python examples/score_jpeg_bytes.py`` (random weights + random
+JPEGs; swap ``vgg.init`` for restored weights in a deployment).
+"""
+
+import io
+
+import numpy as np
+
+import _bootstrap  # noqa: F401  (checkout path shim)
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.builder import OpBuilder
+from tensorframes_tpu.graphdef import parse_graphdef
+from tensorframes_tpu.graphdef.builder import GraphBuilder
+from tensorframes_tpu.graphdef.proto import GraphDef
+from tensorframes_tpu.models import vgg
+from tensorframes_tpu.models.vgg_export import export_graphdef
+
+SIDE = 48  # capture size; the frozen graph resizes to 224 in-graph
+
+
+def _jpegs(n):
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        arr = rng.randint(0, 256, (SIDE, SIDE, 3), dtype=np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=92)
+        out.append(buf.getvalue())
+    return out
+
+
+def frozen_graph_with_decode(width_mult: float) -> bytes:
+    """VGG-16 frozen bytes with the reference's decode front-end:
+    ``DecodeJpeg/contents`` -> DecodeJpeg -> ExpandDims -> vgg ``image``."""
+    front = GraphBuilder()
+    front.placeholder("DecodeJpeg/contents", "binary", [])
+    front.op("DecodeJpeg", "DecodeJpeg", ["DecodeJpeg/contents"], channels=3)
+    ax = front.const("batch_axis", np.int32(0))
+    front.op("ExpandDims", "batched", ["DecodeJpeg", ax])
+    vgg_graph = parse_graphdef(export_graphdef(vgg.init(0, width_mult)))
+    nodes = [n for n in front.build().nodes]
+    for node in vgg_graph.nodes:
+        if node.op == "Placeholder" and node.name == "image":
+            continue  # the decode front-end replaces the pixel placeholder
+        node.inputs = ["batched" if i == "image" else i for i in node.inputs]
+        nodes.append(node)
+    return GraphDef(nodes).encode()
+
+
+def main(n_rows: int = 4, width_mult: float = 0.125) -> None:
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays(
+            {"image_data": _jpegs(n_rows)}, num_blocks=2
+        )
+    )
+    out = (
+        OpBuilder.map_rows(frame)
+        .graph(frozen_graph_with_decode(width_mult))
+        .fetches(["value", "index"])
+        .inputs({"DecodeJpeg/contents": "image_data"})   # read_image.py:164
+        .build_df()
+    )
+    for i, row in enumerate(out.collect()):
+        top = np.asarray(row["index"])[0]
+        print(f"img_{i}.jpg  class[0]={int(top[0])}  "
+              f"p={float(np.asarray(row['value'])[0][0]):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Host-side image decoding for in-graph ``Decode*`` nodes.
+
+The reference's flagship scoring graph begins at ``DecodeJpeg``
+(``read_image.py:120-167``): users feed ENCODED bytes and the graph
+decodes in-session.  XLA can host neither string tensors nor the
+data-dependent [H, W, C] shape a decoder produces, so the TPU-native
+split runs decode on the host — this module supplies the PIL-backed
+stage functions that ``importer.import_graphdef`` attaches to a
+Program's ``host_prelude`` when it meets a decode node (the engine
+merges the prelude into the verb's ``host_stage`` automatically).
+
+Uniformity contract: a host stage must emit one uniform [rows, H, W, C]
+array per device call, so every image inside one block (``map_blocks``)
+or one shape bucket (``map_rows``) must share a size.  Mixed sizes raise
+with guidance rather than silently padding — grouping by size (or
+pre-resizing on host) is the caller's policy decision.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+# ops the importer routes to a host prelude instead of a device lowering
+DECODE_OPS = ("DecodeJpeg", "DecodePng", "DecodeImage", "DecodeBmp")
+
+_MODES = {1: "L", 3: "RGB", 4: "RGBA"}
+
+
+def pil_decoder(channels: int = 0, op: str = "DecodeJpeg"):
+    """Build a host_stage fn: list of encoded byte cells -> uint8 pixels.
+
+    ``channels`` follows the TF attr: 0 = the file's native channel
+    count (grayscale stays [H, W, 1], RGB stays 3-channel, PNG alpha is
+    kept — TF's behaviour), 1 = grayscale, 3 = RGB, 4 = RGBA.
+    """
+    ch = int(channels)
+    mode = _MODES.get(ch) if ch else None  # None: decode natively
+    if ch and mode is None:
+        raise ValueError(
+            f"{op}: channels={channels} is not decodable (0, 1, 3 or 4)"
+        )
+
+    def decode(cells):
+        try:
+            from PIL import Image
+        except ImportError as e:  # pragma: no cover - depends on install
+            raise RuntimeError(
+                f"decoding an in-graph {op} node needs the optional "
+                f"Pillow dependency, which is not importable here; pass "
+                f"an explicit host_stage fn for this input instead"
+            ) from e
+        arrs = []
+        for c in cells:
+            img = Image.open(io.BytesIO(bytes(c)))
+            if mode is not None:
+                img = img.convert(mode)
+            elif img.mode not in ("L", "RGB", "RGBA"):
+                # palette/CMYK/LA files have no TF-decode layout; RGB is
+                # what TF's decoders produce for them
+                img = img.convert("RGB")
+            a = np.asarray(img, dtype=np.uint8)
+            if a.ndim == 2:  # "L" gives [H, W]; TF emits [H, W, 1]
+                a = a[..., None]
+            arrs.append(a)
+        sizes = {a.shape for a in arrs}
+        if len(sizes) > 1:
+            raise ValueError(
+                f"{op} host decode produced mixed image sizes {sorted(sizes)} "
+                f"within one device call; images must be uniform per block "
+                f"(map_blocks) or per shape bucket (map_rows) — group rows "
+                f"by size or pre-resize in a custom host_stage"
+            )
+        return np.stack(arrs)
+
+    return decode

@@ -24,6 +24,7 @@ import numpy as np
 from .. import dtypes as dt
 from ..program import Program, ProgramError
 from ..shape import Shape
+from . import decode as decode_mod
 from . import ops as op_registry
 from .proto import GraphDef, NodeDef, TensorProto, parse_graphdef
 
@@ -137,6 +138,65 @@ def import_graphdef(
             "GraphDef has no Placeholder nodes; programs need at least one "
             "column-fed input"
         )
+
+    # in-graph image decode (read_image.py:120-167 feeds encoded bytes to a
+    # graph starting at DecodeJpeg): route each reachable Decode* node to a
+    # host prelude on the placeholder that feeds it — XLA hosts neither
+    # string tensors nor the data-dependent decoded shape
+    decode_src: Dict[str, str] = {}  # decode node -> feeding placeholder
+    host_prelude: Dict[str, Any] = {}
+    ph_set = set(input_names)
+    for n in graph.nodes:
+        if n.op not in decode_mod.DECODE_OPS or n.name not in reachable:
+            continue
+        src, _ = _split_ref(n.inputs[0])
+        seen = set()
+        while (
+            src in nodes
+            and nodes[src].op in ("Identity", "Snapshot")
+            and src not in seen
+        ):
+            seen.add(src)
+            src, _ = _split_ref(nodes[src].inputs[0])
+        if src not in ph_set:
+            raise GraphImportError(
+                f"{n.op} node {n.name!r} decodes a computed value; only "
+                f"placeholder-fed bytes can be decoded (the decode runs as "
+                f"a host stage before the device program)"
+            )
+        # attrs the PIL prelude cannot honour are rejected here, not
+        # silently diverged from: TF's dtype attr rescales values
+        # (float in [0,1], uint16) and ratio downsamples at decode
+        dt_av = n.attrs.get("dtype")
+        if dt_av is not None and dt_av.kind == "type" and dt_av.value != 4:
+            raise GraphImportError(
+                f"{n.op} node {n.name!r} requests dtype enum "
+                f"{dt_av.value}; only uint8 decode is supported (pass an "
+                f"explicit host_stage fn for other output types)"
+            )
+        ratio_av = n.attrs.get("ratio")
+        if ratio_av is not None and ratio_av.kind == "i" and int(
+            ratio_av.value
+        ) not in (0, 1):
+            raise GraphImportError(
+                f"{n.op} node {n.name!r} requests decode ratio "
+                f"{int(ratio_av.value)}; downsampling decode is not "
+                f"supported (pass an explicit host_stage fn)"
+            )
+        ch_av = n.attrs.get("channels")
+        channels = int(ch_av.value) if ch_av and ch_av.kind == "i" else 0
+        if src in decode_src.values() and n.name not in decode_src:
+            prev = next(d for d, s in decode_src.items() if s == src)
+            prev_ch = host_prelude[src]._tfs_channels
+            if int(channels) != prev_ch:
+                raise GraphImportError(
+                    f"placeholder {src!r} feeds decode nodes with "
+                    f"conflicting channels ({prev!r} vs {n.name!r})"
+                )
+        decode_src[n.name] = src
+        fn = decode_mod.pil_decoder(channels, n.op)
+        fn._tfs_channels = int(channels)
+        host_prelude[src] = fn
     feed = dict(inputs or {})
     for k in feed:
         if k not in input_names:
@@ -214,6 +274,11 @@ def import_graphdef(
                     f"placeholder {name!r} was not fed; feeds: "
                     f"{sorted(feeds)}"
                 )
+            if node.op in decode_mod.DECODE_OPS:
+                # the host prelude already decoded this placeholder's
+                # bytes: the decode node's output IS the fed value
+                cache[name] = cache[decode_src[name]]
+                continue
             impl = op_registry.REGISTRY.get(node.op)
             if impl is None:
                 raise op_registry.UnsupportedOpError(
@@ -231,12 +296,14 @@ def import_graphdef(
             out: _pick(name, cache[name], idx) for out, name, idx in fetch_list
         }
 
-    return Program(
+    program = Program(
         fn,
         input_names,
         fetches=[out for out, _, _ in fetch_list],
         feed_dict=feed,
     )
+    program.host_prelude.update(host_prelude)
+    return program
 
 
 def placeholder_specs(

@@ -29,6 +29,7 @@ _BOOL = dt.by_name("bool").tf_enum
 _I32 = dt.by_name("int32").tf_enum
 _I64 = dt.by_name("int64").tf_enum
 _F32 = dt.by_name("float32").tf_enum
+_U8 = dt.by_name("uint8").tf_enum
 
 # ops whose single output and required ``T`` both take the first input's
 # dtype (elementwise unary/binary, activations, pooling, conv...)
@@ -317,6 +318,8 @@ def complete_for_tf(graph: GraphDef) -> GraphDef:
         elif op == "ResizeBilinear":
             put("T", t0)
             outs = [_F32]
+        elif op in ("DecodeJpeg", "DecodePng", "DecodeBmp", "DecodeImage"):
+            outs = [have("dtype") or _U8]
         # unknown op: leave attrs alone; outs defaults to [first input dtype]
 
         out_dtypes[node.name] = outs

@@ -77,6 +77,18 @@ def _check_shape_hints(
             ) from e
 
 
+def _with_prelude(program: Program, host_stage):
+    """Merge the program's ``host_prelude`` (e.g. the GraphDef importer's
+    in-graph Decode* stages) under any caller-supplied ``host_stage`` —
+    an explicit stage wins per input."""
+    prelude = getattr(program, "host_prelude", None)
+    if not prelude:
+        return host_stage
+    merged = dict(prelude)
+    merged.update(host_stage or {})
+    return merged
+
+
 def _np(x) -> np.ndarray:
     return np.asarray(jax.device_get(x))
 
@@ -302,6 +314,7 @@ class Executor:
         ``host_stage``: input name -> host fn(cells) -> [rows, *cell] array,
         run per block before the device program (binary decode, bucketing);
         block N+1's host stage overlaps block N's device compute."""
+        host_stage = _with_prelude(program, host_stage)
         with observability.verb_span(
             "map_blocks", frame.num_rows, frame.num_blocks
         ) as span:
@@ -356,6 +369,7 @@ class Executor:
         """``mapRows`` (``DebugRowOps.scala:396-477``): the program is written
         at *cell* level and vmapped over the block's rows.  Ragged input
         columns are resolved per row by shape-bucketing (`_map_rows_ragged`)."""
+        host_stage = _with_prelude(program, host_stage)
         with observability.verb_span(
             "map_rows", frame.num_rows, frame.num_blocks
         ) as span:

@@ -42,7 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import dtypes
 from ..frame import TensorFrame
 from ..ops import segment_compile, validation
-from ..ops.engine import Executor, _check_shape_hints, _np
+from ..ops.engine import Executor, _check_shape_hints, _np, _with_prelude
 from ..ops.validation import ValidationError
 from ..program import Program
 from .mesh import data_mesh
@@ -227,6 +227,7 @@ class MeshExecutor(Executor):
         trim: bool = False,
         host_stage=None,
     ) -> TensorFrame:
+        host_stage = _with_prelude(program, host_stage)
         infos = validation.check_map_inputs(
             program, frame, "map_blocks", host_staged=host_stage or ()
         )
@@ -324,6 +325,7 @@ class MeshExecutor(Executor):
         the globally sharded batch (``DebugRowOps.scala:819-857`` -> vmap).
         Rows are independent under vmap, so uneven row counts are padded to a
         mesh multiple (and trimmed after) instead of under-sharding."""
+        host_stage = _with_prelude(program, host_stage)
         infos = validation.check_map_inputs(
             program,
             frame,

@@ -146,6 +146,10 @@ class Program:
         # output name -> Shape hint (ShapeDescription.scala:3-16); applied by
         # analyze() as a refinement and checked by the verbs at run time
         self._shape_hints: Dict[str, Shape] = {}
+        # input name -> host preprocessing fn the engine merges into each
+        # verb's host_stage (set by the GraphDef importer for in-graph
+        # Decode* nodes; an explicit caller host_stage wins per input)
+        self.host_prelude: Dict[str, Any] = {}
 
     # -- construction --------------------------------------------------------
 
@@ -236,6 +240,7 @@ class Program:
             self._params,
         )
         p._shape_hints = dict(self._shape_hints)
+        p.host_prelude = dict(self.host_prelude)
         return p
 
     def with_shape_hints(
@@ -254,6 +259,7 @@ class Program:
             self._params,
         )
         p._shape_hints = dict(self._shape_hints)
+        p.host_prelude = dict(self.host_prelude)
         for name, s in hints.items():
             p._shape_hints[name] = Shape(s)
         if self._declared_fetches is not None:

@@ -1,0 +1,208 @@
+"""In-graph image decode lowers to an automatic host prelude.
+
+The reference's flagship flow feeds ENCODED JPEG bytes to a frozen graph
+whose first node is ``DecodeJpeg`` (``read_image.py:164-167``: feed_dict
+``{'DecodeJpeg/contents': 'image_data'}``).  XLA cannot host string
+tensors or data-dependent shapes, so the TPU-native split keeps decode on
+the host: ``import_graphdef`` detects ``DecodeJpeg``/``DecodePng``/
+``DecodeImage`` nodes fed by a placeholder and attaches a PIL-backed
+``host_prelude`` to the Program; the engine merges it into the verb's
+``host_stage`` automatically, so the reference's exact call shape — graph
+bytes + feed_dict, no manual decode fn — just works.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.builder import OpBuilder
+from tensorframes_tpu.graphdef import import_graphdef
+from tensorframes_tpu.graphdef.builder import GraphBuilder
+from tensorframes_tpu.graphdef.importer import GraphImportError
+from tensorframes_tpu.ops.validation import ValidationError
+
+
+def _jpeg(arr) -> bytes:
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def _png(arr) -> bytes:
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _pixels(data: bytes, mode="RGB") -> np.ndarray:
+    return np.asarray(Image.open(io.BytesIO(data)).convert(mode), np.uint8)
+
+
+def _decode_graph(op: str, channels: int = 3, cast_out: bool = True):
+    """contents -> Decode* -> Cast f32 -> Mean over H,W -> 'mean'."""
+    g = GraphBuilder()
+    g.placeholder("contents", "binary", [])
+    attrs = {"channels": channels} if channels else {}
+    g.op(op, "decoded", ["contents"], **attrs)
+    if cast_out:
+        from tensorframes_tpu import dtypes as dt
+        from tensorframes_tpu.graphdef.proto import AttrValue
+
+        g.op(
+            "Cast", "as_f32", ["decoded"],
+            DstT=AttrValue("type", dt.by_name("float32").tf_enum),
+        )
+        ax = g.const("hw", np.asarray([0, 1], np.int32))
+        g.op("Mean", "mean", ["as_f32", ax])
+    return g.to_bytes()
+
+
+def _rng_image(seed, side=12):
+    return np.random.RandomState(seed).randint(
+        0, 255, (side, side, 3), dtype=np.uint8)
+
+
+def test_decode_jpeg_auto_prelude_map_rows():
+    """The reference call shape: graph + feed_dict, no manual host_stage."""
+    blobs = [_jpeg(_rng_image(i)) for i in range(6)]
+    frame = tfs.analyze(tfs.TensorFrame.from_arrays(
+        {"image_data": blobs}, num_blocks=2))
+    out = (
+        OpBuilder.map_rows(frame)
+        .graph(_decode_graph("DecodeJpeg"))
+        .fetches(["mean"])
+        .inputs({"contents": "image_data"})
+        .build_df()
+    )
+    got = np.asarray([r["mean"] for r in out.collect()])
+    # JPEG is lossy, so the oracle is the same PIL decode of the same bytes
+    expect = np.stack([
+        _pixels(b).astype(np.float32).mean(axis=(0, 1)) for b in blobs
+    ])
+    np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-4)
+
+
+def test_decode_png_exact_pixels():
+    """PNG is lossless: decoded pixels must equal the source exactly."""
+    imgs = [_rng_image(i) for i in range(4)]
+    frame = tfs.analyze(tfs.TensorFrame.from_arrays(
+        {"raw": [_png(im) for im in imgs]}))
+    p = import_graphdef(
+        _decode_graph("DecodePng", cast_out=False), fetches=["decoded"])
+    out = tfs.map_rows(p, frame, feed_dict={"contents": "raw"})
+    got = np.stack([np.asarray(r["decoded"]) for r in out.collect()])
+    np.testing.assert_array_equal(got, np.stack(imgs))
+    assert got.dtype == np.uint8
+
+
+def test_decode_grayscale_channels_1():
+    imgs = [_rng_image(i) for i in range(3)]
+    frame = tfs.analyze(tfs.TensorFrame.from_arrays(
+        {"raw": [_png(im) for im in imgs]}))
+    p = import_graphdef(
+        _decode_graph("DecodePng", channels=1, cast_out=False),
+        fetches=["decoded"])
+    out = tfs.map_rows(p, frame, feed_dict={"contents": "raw"})
+    got = np.stack([np.asarray(r["decoded"]) for r in out.collect()])
+    assert got.shape == (3, 12, 12, 1)
+    expect = np.stack([
+        _pixels(_png(im), mode="L")[..., None] for im in imgs
+    ])
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_explicit_host_stage_overrides_prelude():
+    frame = tfs.analyze(tfs.TensorFrame.from_arrays(
+        {"raw": [b"ignored", b"bytes"]}))
+    fixed = np.full((2, 4, 4, 3), 7, np.uint8)
+    out = (
+        OpBuilder.map_rows(frame)
+        .graph(_decode_graph("DecodeJpeg"))
+        .fetches(["mean"])
+        .inputs({"contents": "raw"})
+        .host_stage("contents", lambda cells: fixed[: len(cells)])
+        .build_df()
+    )
+    got = np.asarray([r["mean"] for r in out.collect()])
+    np.testing.assert_allclose(got, np.full((2, 3), 7.0))
+
+
+def test_mixed_sizes_in_one_block_error():
+    blobs = [_jpeg(_rng_image(0, side=8)), _jpeg(_rng_image(1, side=16))]
+    frame = tfs.analyze(tfs.TensorFrame.from_arrays({"raw": blobs}))
+    p = import_graphdef(_decode_graph("DecodeJpeg"), fetches=["mean"])
+    with pytest.raises((ValidationError, ValueError), match="size|uniform"):
+        tfs.map_blocks(p, frame, feed_dict={"contents": "raw"}).collect()
+
+
+def test_decode_of_computed_value_rejected():
+    g = GraphBuilder()
+    g.placeholder("a", "binary", [])
+    g.op("Identity", "i1", ["a"])
+    g.op("DecodeJpeg", "d", ["i1"])  # identity chain is fine
+    import_graphdef(g.to_bytes(), fetches=["d"])
+
+    g2 = GraphBuilder()
+    g2.placeholder("x", "float32", [4])
+    g2.op("Neg", "n", ["x"])
+    g2.op("DecodeJpeg", "d", ["n"])
+    with pytest.raises(GraphImportError, match="computed"):
+        import_graphdef(g2.to_bytes(), fetches=["d"])
+
+
+def test_native_channels_grayscale_kept():
+    """channels=0 means the file's native layout: grayscale stays
+    [H, W, 1] (TF semantics), not silently widened to RGB."""
+    gray = np.random.RandomState(5).randint(0, 255, (9, 9), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(gray, mode="L").save(buf, format="PNG")
+    frame = tfs.analyze(tfs.TensorFrame.from_arrays(
+        {"raw": [buf.getvalue()]}))
+    p = import_graphdef(
+        _decode_graph("DecodePng", channels=0, cast_out=False),
+        fetches=["decoded"])
+    out = tfs.map_rows(p, frame, feed_dict={"contents": "raw"})
+    got = np.asarray(out.collect()[0]["decoded"])
+    assert got.shape == (9, 9, 1)
+    np.testing.assert_array_equal(got[..., 0], gray)
+
+
+def test_unsupported_decode_attrs_rejected():
+    from tensorframes_tpu.graphdef.proto import AttrValue
+
+    g = GraphBuilder()
+    g.placeholder("c", "binary", [])
+    g.op("DecodeJpeg", "d", ["c"], ratio=4)
+    with pytest.raises(GraphImportError, match="ratio"):
+        import_graphdef(g.to_bytes(), fetches=["d"])
+
+    g2 = GraphBuilder()
+    g2.placeholder("c", "binary", [])
+    g2.op("DecodeImage", "d", ["c"], dtype=AttrValue("type", 1))  # float
+    with pytest.raises(GraphImportError, match="dtype"):
+        import_graphdef(g2.to_bytes(), fetches=["d"])
+
+
+def test_decode_on_mesh_executor():
+    """The distributed engine honours the prelude too (same merge)."""
+    from tensorframes_tpu.parallel.dist import MeshExecutor
+    from tensorframes_tpu.parallel.mesh import data_mesh
+
+    blobs = [_png(_rng_image(i)) for i in range(8)]
+    frame = tfs.analyze(tfs.TensorFrame.from_arrays({"raw": blobs}))
+    p = import_graphdef(_decode_graph("DecodePng"), fetches=["mean"])
+    with data_mesh(8) as mesh:
+        out = tfs.map_rows(
+            p, frame, feed_dict={"contents": "raw"},
+            engine=MeshExecutor(mesh),
+        )
+        got = np.asarray([r["mean"] for r in out.collect()])
+    expect = np.stack([
+        _pixels(b).astype(np.float32).mean(axis=(0, 1)) for b in blobs
+    ])
+    np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-4)

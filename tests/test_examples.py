@@ -86,3 +86,11 @@ def test_score_frozen_vgg_example(capsys):
     mod["main"](n_rows=2, width_mult=0.0625)
     out = capsys.readouterr().out
     assert "frozen VGG-16 GraphDef" in out and "class=" in out
+
+
+def test_score_jpeg_bytes_example(capsys):
+    pytest.importorskip("PIL")
+    mod = _run("score_jpeg_bytes.py")
+    mod["main"](n_rows=2, width_mult=0.0625)
+    out = capsys.readouterr().out
+    assert out.count("class[0]=") == 2

@@ -550,6 +550,11 @@ REGISTRY: Dict[str, Callable[[List[Any], Dict], Any]] = {
         jnp.swapaxes(ins[1], -1, -2) if _attr(at, "adj_y", False) else ins[1],
     ),
     "BiasAdd": lambda ins, at: ins[0] + ins[1],
+    # TF-2.x frozen graphs express most contractions as Einsum; the
+    # equation attr is jnp.einsum's own grammar (ellipses included)
+    "Einsum": lambda ins, at: jnp.einsum(
+        _str_attr(at, "equation", b""), *ins
+    ),
     "Conv2D": _conv2d,
     "DepthwiseConv2dNative": _depthwise_conv2d,
     "MaxPool": lambda ins, at: _pool(ins[0], at, lax.max, -jnp.inf),

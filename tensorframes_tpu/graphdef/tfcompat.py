@@ -112,8 +112,9 @@ def complete_for_tf(graph: GraphDef) -> GraphDef:
     untouched — the pass is best-effort and never raises on them; every op
     in the importer registry (``docs/GRAPHDEF_OPS.md``) is covered.  The
     only attrs it cannot conjure are ``Split.num_split`` / ``Unpack.num``
-    (they define the node's output arity, so the author must supply them —
-    our own importer requires them too); ``SplitV.num_split`` is derived
+    (they define the node's output arity) and ``Einsum.equation`` (it
+    defines the contraction itself) — the author must supply those, and
+    our own importer requires them too; ``SplitV.num_split`` is derived
     from the ``size_splits`` Const when missing.
     """
     out_dtypes: Dict[str, List[Optional[int]]] = {}
@@ -187,6 +188,9 @@ def complete_for_tf(graph: GraphDef) -> GraphDef:
             put("T", t)
             outs = [t]
         elif op == "AddN":
+            put_int("N", n_data)
+            put("T", t0)
+        elif op == "Einsum":
             put_int("N", n_data)
             put("T", t0)
         elif op == "IdentityN":

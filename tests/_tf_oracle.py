@@ -172,10 +172,17 @@ def case_linalg():
     tf.raw_ops.BatchMatMulV2(x=bm1, y=bm2, name="bmmv2")
     tf.raw_ops.BatchMatMulV2(x=bm1, y=bmb, name="bmm_bcast")
     tf.raw_ops.BiasAdd(value=mm, bias=bias, name="biasadd")
+    tf.raw_ops.Einsum(inputs=[a, b], equation="ij,jk->ik", name="ein_mm")
+    tf.raw_ops.Einsum(inputs=[bm1, bm2], equation="bij,bjk->bik",
+                      name="ein_bmm")
+    tf.raw_ops.Einsum(inputs=[bm1], equation="bij->bji", name="ein_t")
+    tf.raw_ops.Einsum(inputs=[bm1, bm1], equation="...ij,...ij->...i",
+                      name="ein_dot")
     return {
         "a": a_v, "b": b_v, "bm1": bm1_v, "bm2": bm2_v, "bmb": bmb_v,
         "bias": bias_v,
-    }, ["mm", "mm_ta", "mm_tb", "bmm", "bmmv2", "bmm_bcast", "biasadd"]
+    }, ["mm", "mm_ta", "mm_tb", "bmm", "bmmv2", "bmm_bcast", "biasadd",
+        "ein_mm", "ein_bmm", "ein_t", "ein_dot"]
 
 
 def case_reduce():

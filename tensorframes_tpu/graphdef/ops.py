@@ -354,29 +354,31 @@ def _space_depth(ins, attrs, to_depth: bool):
     return jnp.reshape(x, (n, h * bs, w * bs, c // (bs * bs)))
 
 
-def _conv2d_backprop_input(ins, attrs):
-    """TF ``Conv2DBackpropInput`` used as a DECONV layer in inference
-    graphs (segmentation/upsampling nets): the gradient of Conv2D w.r.t.
-    its input, applied as a forward op.
+def _conv_backprop_input(ins, attrs, spatial: int, op_name: str):
+    """TF ``Conv{2,3}DBackpropInput`` used as a DECONV layer in inference
+    graphs (segmentation/upsampling nets): the gradient of the forward
+    conv w.r.t. its input, applied as a forward op.
 
     Lowered in the exact adjoint form — an lhs-dilated conv of the
     spatially-flipped, channel-swapped kernel with per-edge padding
     derived from the FORWARD conv's padding — so every ``input_sizes``
     TF accepts round-trips exactly, including odd SAME shapes with
     stride 2 (the classic DeepLab 65x65) and dilated kernels."""
-    in_shape = [int(d) for d in _static(ins[0], "Conv2DBackpropInput "
-                                                 "input_sizes")]
-    w, dy = ins[1], ins[2]  # w: [H, W, Cin, Cout]; dy: [N, Ho, Wo, Cout]
-    strides = [int(s) for s in _attr(attrs, "strides", [1, 1, 1, 1])]
-    dilations = [int(d) for d in _attr(attrs, "dilations", [1, 1, 1, 1])]
+    in_shape = [int(d) for d in _static(ins[0], f"{op_name} input_sizes")]
+    # w: [*K, Cin, Cout]; dy: [N, *out_spatial, Cout]
+    w, dy = ins[1], ins[2]
+    ones = [1] * (spatial + 2)
+    strides = [int(s) for s in _attr(attrs, "strides", ones)]
+    dilations = [int(d) for d in _attr(attrs, "dilations", ones)]
     padding = _padding_str(attrs)
-    fmt = _str_attr(attrs, "data_format", b"NHWC")
-    if fmt != "NHWC":
+    default_fmt = b"NDHWC" if spatial == 3 else b"NHWC"
+    fmt = _str_attr(attrs, "data_format", default_fmt)
+    if fmt != default_fmt.decode():
         raise UnsupportedOpError(
-            f"Conv2DBackpropInput data_format {fmt} not supported"
+            f"{op_name} data_format {fmt} not supported"
         )
     pads = []
-    for i in (0, 1):  # spatial dims
+    for i in range(spatial):
         hi_in, ho = in_shape[1 + i], dy.shape[1 + i]
         s, d, k = strides[1 + i], dilations[1 + i], w.shape[i]
         k_eff = (k - 1) * d + 1
@@ -388,16 +390,23 @@ def _conv2d_backprop_input(ins, attrs):
         lo = k_eff - 1 - fwd_lo
         hi = hi_in - 1 - (ho - 1) * s + fwd_lo
         pads.append((lo, hi))
-    w2 = jnp.flip(jnp.asarray(w), (0, 1)).swapaxes(2, 3)  # [H,W,Cout,Cin]
+    w2 = jnp.flip(jnp.asarray(w), tuple(range(spatial)))
+    w2 = w2.swapaxes(spatial, spatial + 1)  # [*K, Cout, Cin]
+    io_layout = ("NDHWC", "DHWIO", "NDHWC") if spatial == 3 else (
+        "NHWC", "HWIO", "NHWC")
     return lax.conv_general_dilated(
         dy,
         w2,
-        window_strides=(1, 1),
+        window_strides=(1,) * spatial,
         padding=pads,
-        lhs_dilation=tuple(strides[1:3]),
-        rhs_dilation=tuple(dilations[1:3]),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        lhs_dilation=tuple(strides[1:1 + spatial]),
+        rhs_dilation=tuple(dilations[1:1 + spatial]),
+        dimension_numbers=io_layout,
     )
+
+
+def _conv2d_backprop_input(ins, attrs):
+    return _conv_backprop_input(ins, attrs, 2, "Conv2DBackpropInput")
 
 
 def _space_to_batch_nd(ins, attrs):
@@ -715,6 +724,9 @@ REGISTRY: Dict[str, Callable[[List[Any], Dict], Any]] = {
     "Cumprod": _cum(jnp.cumprod),
     # deconv + dilated-conv plumbing (segmentation/deeplab-style graphs)
     "Conv2DBackpropInput": _conv2d_backprop_input,
+    "Conv3DBackpropInputV2": lambda ins, at: _conv_backprop_input(
+        ins, at, 3, "Conv3DBackpropInputV2"
+    ),
     "SpaceToBatchND": _space_to_batch_nd,
     "BatchToSpaceND": _batch_to_space_nd,
     # graph plumbing aliases

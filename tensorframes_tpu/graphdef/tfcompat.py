@@ -301,7 +301,7 @@ def complete_for_tf(graph: GraphDef) -> GraphDef:
         elif op == "Range":
             put("Tidx", t0)
             outs = [t0]
-        elif op == "Conv2DBackpropInput":
+        elif op in ("Conv2DBackpropInput", "Conv3DBackpropInputV2"):
             t = in_dt(node, 1)
             put("T", t)
             outs = [t]

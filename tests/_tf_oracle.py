@@ -352,6 +352,12 @@ def case_convpool():
                          name="avgpool3d_same")
     # (dilated Conv3D omitted: TF's own CPU kernel rejects dilation > 1,
     # so no golden can be produced)
+    small3 = tf.raw_ops.Conv3D(input=vol, filter=k3,
+                               strides=[1, 1, 2, 2, 1], padding="SAME")
+    tf.raw_ops.Conv3DBackpropInputV2(
+        input_sizes=tf.constant([1, 4, 6, 6, 2]), filter=k3,
+        out_backprop=small3, strides=[1, 1, 2, 2, 1], padding="SAME",
+        name="deconv3d")
     mp = tf.constant([[0, 0], [1, 2], [2, 1], [0, 0]])
     tf.raw_ops.MirrorPad(input=img, paddings=mp, mode="REFLECT",
                          name="mirror_ref")
@@ -369,6 +375,7 @@ def case_convpool():
         "conv_same", "conv_valid_s2", "conv_dil", "dwconv", "maxpool",
         "avgpool", "fbn3:0", "lrn", "deconv", "s2b", "b2s", "conv3d",
         "conv3d_s2", "maxpool3d", "avgpool3d", "avgpool3d_same",
+        "deconv3d",
         "mirror_ref", "mirror_sym",
         "bilinear", "bilinear_ac", "bilinear_hp", "nearest",
     ]

@@ -377,6 +377,11 @@ def _conv_backprop_input(ins, attrs, spatial: int, op_name: str):
         raise UnsupportedOpError(
             f"{op_name} data_format {fmt} not supported"
         )
+    if padding not in ("SAME", "VALID"):
+        raise UnsupportedOpError(
+            f"{op_name} padding {padding!r} not supported (EXPLICIT "
+            f"paddings would silently change the adjoint arithmetic)"
+        )
     pads = []
     for i in range(spatial):
         hi_in, ho = in_shape[1 + i], dy.shape[1 + i]

@@ -304,6 +304,10 @@ def complete_for_tf(graph: GraphDef) -> GraphDef:
         elif op in ("Conv2DBackpropInput", "Conv3DBackpropInputV2"):
             t = in_dt(node, 1)
             put("T", t)
+            if op == "Conv3DBackpropInputV2":
+                # unlike the 2D op (fixed int32 input_sizes), the 3D op
+                # types its input_sizes operand via Tshape
+                put("Tshape", in_dt(node, 0))
             outs = [t]
         elif op == "FusedBatchNorm":
             put("T", t0)

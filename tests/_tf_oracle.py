@@ -567,9 +567,23 @@ def run_ours_job(spec, workdir):
     return {"npz": out_name, "fetches": spec["fetches"]}
 
 
+def run_echo_job(spec, workdir):
+    """Codec-fuzz leg: TF parses OUR serialized bytes and re-serializes
+    them deterministically; the test then re-parses the echo with the
+    repo codec and requires structural identity — any wire-format
+    nonconformance in either direction breaks the loop."""
+    with open(os.path.join(workdir, spec["pb"]), "rb") as f:
+        gd = tf1.GraphDef.FromString(f.read())
+    out_name = spec["name"] + ".tfecho.pb"
+    with open(os.path.join(workdir, out_name), "wb") as f:
+        f.write(gd.SerializeToString(deterministic=True))
+    return {"pb": out_name, "nodes": len(gd.node)}
+
+
 def main():
     workdir = sys.argv[1]
-    manifest = {"tf_version": tf.__version__, "build": {}, "ours": {}}
+    manifest = {"tf_version": tf.__version__, "build": {}, "ours": {},
+                "echo": {}}
     for name, fn in BUILD_CASES.items():
         manifest["build"][name] = run_build_case(name, fn, workdir)
     manifest["frozen_cnn"] = build_frozen_cnn(workdir)
@@ -580,6 +594,12 @@ def main():
             jobs = json.load(f)
         for spec in jobs:
             manifest["ours"][spec["name"]] = run_ours_job(spec, workdir)
+    echo_path = os.path.join(workdir, "echo_jobs.json")
+    if os.path.exists(echo_path):
+        with open(echo_path) as f:
+            jobs = json.load(f)
+        for spec in jobs:
+            manifest["echo"][spec["name"]] = run_echo_job(spec, workdir)
     with open(os.path.join(workdir, "goldens.json"), "w") as f:
         json.dump(manifest, f, indent=1)
     print("tf-oracle: ok")

@@ -117,6 +117,10 @@ def tf_goldens(tmp_path_factory):
         })
     (wd / "ours_jobs.json").write_text(json.dumps(jobs))
 
+    (wd / "fuzz_codec.pb").write_bytes(_fuzz_graph().encode())
+    (wd / "echo_jobs.json").write_text(json.dumps(
+        [{"name": "fuzz_codec", "pb": "fuzz_codec.pb"}]))
+
     proc = subprocess.run(
         [sys.executable, _ORACLE, str(wd)],
         capture_output=True, text=True, timeout=900,
@@ -258,6 +262,128 @@ def test_tf_executes_our_inception_export(tf_goldens):
     np.testing.assert_allclose(
         np.asarray(native["score"]), tf_out["out__score"],
         rtol=2e-2, atol=1e-4)
+
+
+def _fuzz_graph(n_nodes: int = 48, seed: int = 2024):
+    """A seeded adversarial GraphDef: every attr kind, negative ints,
+    int64 extremes, infinities, zero-length strings/tensors, unknown
+    dims, unicode/slash names, multi-output refs and control edges."""
+    from tensorframes_tpu.graphdef.proto import (
+        AttrValue, GraphDef, NodeDef, TensorProto,
+    )
+    from tensorframes_tpu.shape import Shape
+
+    r = np.random.RandomState(seed)
+    dtypes_pool = [np.float32, np.float64, np.int32, np.int64,
+                   np.uint8, np.bool_]
+
+    def rand_tensor():
+        dt_ = dtypes_pool[r.randint(len(dtypes_pool))]
+        shape = tuple(int(d) for d in r.randint(0, 4, r.randint(0, 3)))
+        if dt_ == np.bool_:
+            arr = np.asarray(r.rand(*shape) > 0.5)
+        elif np.issubdtype(dt_, np.integer):
+            info = np.iinfo(dt_)
+            lo = max(info.min, -(2 ** 31))
+            hi = min(int(info.max), 2 ** 31 - 1)
+            arr = np.asarray(r.randint(lo, hi, shape)).astype(dt_)
+        else:
+            arr = np.asarray(r.randn(*shape) * 10).astype(dt_)
+        return TensorProto.from_numpy(arr)
+
+    def rand_attr():
+        kind = r.randint(9)
+        if kind == 0:
+            return AttrValue("s", bytes(r.randint(0, 256, r.randint(0, 9),
+                                                  dtype=np.uint8)))
+        if kind == 1:
+            return AttrValue("i", int(r.choice(
+                [0, -1, 7, -(2 ** 63), 2 ** 63 - 1, int(r.randint(-9, 9))])))
+        if kind == 2:
+            return AttrValue("f", float(r.choice(
+                [0.0, -1.5, float(np.float32(r.randn())), np.inf, -np.inf])))
+        if kind == 3:
+            return AttrValue("b", bool(r.rand() > 0.5))
+        if kind == 4:
+            return AttrValue("type", int(r.choice([1, 2, 3, 4, 9, 10])))
+        if kind == 5:
+            dims = [int(r.choice([-1, 0, 1, 5]))
+                    for _ in range(r.randint(0, 4))]
+            return AttrValue("shape", Shape(dims))
+        if kind == 6:
+            return AttrValue("tensor", rand_tensor())
+        if kind == 7:
+            return AttrValue("type_list",
+                             [int(r.choice([1, 3, 9]))
+                              for _ in range(r.randint(0, 4))])
+        pools = [
+            [int(r.randint(-99, 99)) for _ in range(r.randint(0, 5))],
+            [float(np.float32(r.randn())) for _ in range(r.randint(0, 5))],
+            [bool(r.rand() > 0.5) for _ in range(r.randint(0, 5))],
+            [bytes([65 + int(r.randint(26))]) for _ in range(r.randint(0, 5))],
+        ]
+        return AttrValue("list", pools[r.randint(len(pools))])
+
+    nodes = []
+    for i in range(n_nodes):
+        name = ["n%d" % i, "scope/n%d" % i, "unié_%d" % i][i % 3]
+        inputs = []
+        for _ in range(r.randint(0, 3)):
+            if not nodes:
+                break
+            dep = nodes[r.randint(len(nodes))].name
+            style = r.randint(3)
+            inputs.append(
+                "^" + dep if style == 0
+                else dep if style == 1
+                else f"{dep}:{r.randint(4)}"
+            )
+        attrs = {f"a{k}": rand_attr() for k in range(r.randint(0, 4))}
+        nodes.append(NodeDef(name, "FuzzOp%d" % (i % 5), inputs, attrs))
+    return GraphDef(nodes)
+
+
+def _canonical(g):
+    """Comparable structure; floats/tensors compared by bit pattern."""
+    import struct
+
+    def canon_val(av):
+        v = av.value
+        if av.kind == "f":
+            return struct.pack("<f", v)
+        if av.kind == "tensor":
+            arr = np.asarray(v.value)
+            return (str(arr.dtype), arr.shape, arr.tobytes())
+        if av.kind == "shape":
+            return tuple(v)
+        if av.kind == "list":
+            # tag element types: True == 1 in python, so an int/bool
+            # field mix-up must not compare equal
+            return [
+                ("f", struct.pack("<f", x)) if isinstance(x, float)
+                else ("b", x) if isinstance(x, bool)
+                else ("i", x) if isinstance(x, int)
+                else ("s", x)
+                for x in v
+            ]
+        return v
+
+    return [
+        (n.name, n.op, list(n.inputs),
+         {k: (av.kind, canon_val(av)) for k, av in sorted(n.attrs.items())})
+        for n in g.nodes
+    ]
+
+
+def test_codec_fuzz_round_trips_through_tf(tf_goldens):
+    """Adversarial codec loop: our bytes -> TF parse -> TF deterministic
+    re-serialize -> our parse must be structurally identical."""
+    wd, manifest = tf_goldens
+    spec = manifest["echo"]["fuzz_codec"]
+    original = _fuzz_graph()
+    assert spec["nodes"] == len(original.nodes)
+    echoed = parse_graphdef((wd / spec["pb"]).read_bytes())
+    assert _canonical(echoed) == _canonical(original)
 
 
 def _protodiff_ours():

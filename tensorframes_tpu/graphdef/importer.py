@@ -30,6 +30,9 @@ from .proto import GraphDef, NodeDef, TensorProto, parse_graphdef
 
 _PLACEHOLDER_OPS = ("Placeholder", "PlaceholderV2", "PlaceholderWithDefault")
 
+# dead-branch sentinel for statically-resolved v1 conds (Switch/Merge)
+_DEAD = object()
+
 
 class GraphImportError(ValueError):
     """The GraphDef cannot be lowered (unknown op, bad fetch, cycle...)."""
@@ -239,6 +242,8 @@ def import_graphdef(
     def _pick(name: str, v: Any, idx: int) -> Any:
         if idx == -1:  # control dependency: ordering only, no value
             return None
+        if v is _DEAD:
+            return _DEAD
         if isinstance(v, tuple):
             if idx >= len(v):
                 raise GraphImportError(
@@ -257,6 +262,70 @@ def import_graphdef(
             if name in cache:
                 continue
             node = nodes[name]
+            # dead-tensor rule (TF): a node with ANY fully-dead input —
+            # control edges included — is dead, except Merge, which is
+            # precisely the op that survives dead data inputs
+            if node.op != "Merge" and any(
+                cache[_split_ref(ref)[0]] is _DEAD for ref in node.inputs
+            ):
+                cache[name] = _DEAD
+                continue
+            # v1 control flow with a STATIC predicate (frozen graphs keep
+            # the Switch/Merge a tf.cond left behind when the predicate
+            # froze to a Const): resolve the branch at import time — the
+            # dead branch propagates a sentinel and is never executed,
+            # matching TF's dead-tensor semantics
+            if node.op in ("Switch", "RefSwitch"):
+                data_refs = [r for r in node.inputs if not r.startswith("^")]
+                dn, di = _split_ref(data_refs[0])
+                pn, pi = _split_ref(data_refs[1])
+                data = _pick(dn, cache[dn], di)
+                pred = _pick(pn, cache[pn], pi)
+                if data is _DEAD or pred is _DEAD:
+                    cache[name] = _DEAD  # a nested cond in a dead branch
+                    continue
+                try:
+                    pred_arr = np.asarray(pred)  # tracers refuse this
+                except Exception:
+                    raise op_registry.UnsupportedOpError(
+                        f"Switch node {name!r} has a data-dependent "
+                        f"predicate; only constant-predicate conds (the "
+                        f"frozen-graph form) are supported"
+                    ) from None
+                if pred_arr.dtype != np.bool_:
+                    raise GraphImportError(
+                        f"Switch node {name!r} predicate has dtype "
+                        f"{pred_arr.dtype}; expected bool"
+                    )
+                taken = bool(pred_arr)
+                # output:0 = false branch, output:1 = true branch
+                cache[name] = (
+                    _DEAD if taken else data,
+                    data if taken else _DEAD,
+                )
+                continue
+            if node.op == "Merge":
+                vals = []
+                for ref in node.inputs:
+                    rn, ri = _split_ref(ref)
+                    if ri == -1:
+                        continue
+                    vals.append(_pick(rn, cache[rn], ri))
+                alive = [
+                    (i, v) for i, v in enumerate(vals) if v is not _DEAD
+                ]
+                if len(alive) == 0:
+                    cache[name] = _DEAD  # whole cond sits in a dead branch
+                    continue
+                if len(alive) > 1:
+                    raise op_registry.UnsupportedOpError(
+                        f"Merge node {name!r} has {len(alive)} live "
+                        f"inputs; exactly one branch must be statically "
+                        f"selected (constant-predicate cond)"
+                    )
+                idx, val = alive[0]
+                cache[name] = (val, np.int32(idx))
+                continue
             if node.op == "Const":
                 av = node.attrs.get("value")
                 if av is None or not isinstance(av.value, TensorProto):
@@ -291,10 +360,23 @@ def import_graphdef(
                 v = _pick(rn, cache[rn], ri)
                 if ri != -1:
                     ins.append(v)
+            if any(v is _DEAD for v in ins):
+                # inside a statically-dead cond branch: never execute,
+                # propagate deadness toward the Merge (TF's dead-tensor
+                # semantics)
+                cache[name] = _DEAD
+                continue
             cache[name] = impl(ins, node.attrs)
-        return {
+        result = {
             out: _pick(name, cache[name], idx) for out, name, idx in fetch_list
         }
+        dead = sorted(k for k, v in result.items() if v is _DEAD)
+        if dead:
+            raise GraphImportError(
+                f"fetch(es) {dead} lie inside a statically-dead cond "
+                f"branch (their Switch predicate froze the other way)"
+            )
+        return result
 
     program = Program(
         fn,

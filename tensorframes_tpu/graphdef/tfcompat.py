@@ -175,6 +175,13 @@ def complete_for_tf(graph: GraphDef) -> GraphDef:
             outs = [have("dtype")]
         elif op == "NoOp":
             outs = []
+        elif op in ("Switch", "RefSwitch"):
+            put("T", t0)
+            outs = [t0, t0]
+        elif op == "Merge":
+            put("T", t0)
+            put_int("N", n_data)
+            outs = [t0, _I32]
         elif op in _PASS_T:
             put("T", t0)
             if op == "CheckNumerics" and "message" not in attrs:

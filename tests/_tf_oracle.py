@@ -430,6 +430,30 @@ def case_plumbing():
     ]
 
 
+def case_cond():
+    """v1 control flow with constant predicates — the Switch/Merge
+    residue a frozen tf.cond leaves when its predicate froze to a Const
+    (the importer resolves the branch statically)."""
+    tf1.disable_control_flow_v2()
+    r = _rng(12)
+    x_v = r.randn(3, 4).astype(np.float32)
+    x = tf1.placeholder(tf.float32, [3, 4], name="x")
+    t = tf1.cond(tf.constant(True), lambda: x + 1.0, lambda: x * 2.0)
+    f = tf1.cond(tf.constant(False), lambda: x + 1.0, lambda: x * 2.0)
+    tf.raw_ops.Identity(input=t, name="taken_true")
+    tf.raw_ops.Identity(input=f, name="taken_false")
+    tf.raw_ops.Mul(x=t, y=f, name="after_cond")
+    # const-returning branches: the branch value's only tie to the cond
+    # is a CONTROL edge from the switch pivot (dead-tensor propagation
+    # must follow control edges for the Merge to resolve)
+    c = tf1.cond(tf.constant(True),
+                 lambda: tf.constant(7.5), lambda: tf.constant(-2.5))
+    tf.raw_ops.Identity(input=c, name="const_branch")
+    return {"x": x_v}, [
+        "taken_true", "taken_false", "after_cond", "const_branch",
+    ]
+
+
 BUILD_CASES = {
     "arith": case_arith,
     "mathfns": case_mathfns,
@@ -442,6 +466,7 @@ BUILD_CASES = {
     "convpool": case_convpool,
     "gencast": case_gencast,
     "plumbing": case_plumbing,
+    "cond": case_cond,
 }
 
 

@@ -835,3 +835,121 @@ def test_space_batch_nd_round_trip_and_semantics():
     np.testing.assert_allclose(
         out["s2b"][3 * 2], padded[0, 1::2, 1::2, :], rtol=0
     )
+
+
+class TestStaticCond:
+    """v1 Switch/Merge with constant predicates (the frozen tf.cond
+    residue): the branch resolves at import time, the dead branch never
+    executes, and non-static predicates fail with guidance."""
+
+    def _cond_graph(self, pred_value):
+        g = GraphBuilder()
+        g.placeholder("x", "float64", [4])
+        g.const("pred", np.bool_(pred_value))
+        g.op("Switch", "sw", ["x", "pred"])
+        g.op("Mul", "false_branch", ["sw:0", g.const("two", np.float64(2.0))])
+        g.op("Add", "true_branch", ["sw:1", g.const("one", np.float64(1.0))])
+        g.op("Merge", "m", ["false_branch", "true_branch"])
+        g.op("Neg", "out", ["m"])
+        return g.to_bytes()
+
+    def test_true_branch_taken(self):
+        p = import_graphdef(self._cond_graph(True), fetches=["out", "m:1"])
+        res = p.call({"x": np.arange(4.0)})
+        np.testing.assert_allclose(
+            np.asarray(res["out"]), -(np.arange(4.0) + 1.0))
+        assert int(np.asarray(res["m_1"])) == 1  # value_index
+
+    def test_false_branch_taken(self):
+        p = import_graphdef(self._cond_graph(False), fetches=["out"])
+        res = p.call({"x": np.arange(4.0)})
+        np.testing.assert_allclose(
+            np.asarray(res["out"]), -(np.arange(4.0) * 2.0))
+
+    def test_dead_branch_never_executes(self, monkeypatch):
+        """The untaken branch's op must not run (TF dead-tensor rule)."""
+        from tensorframes_tpu.graphdef import ops as op_mod
+
+        calls = []
+        orig = op_mod.REGISTRY["Mul"]
+        monkeypatch.setitem(
+            op_mod.REGISTRY, "Mul",
+            lambda ins, at: calls.append(1) or orig(ins, at))
+        p = import_graphdef(self._cond_graph(True), fetches=["out"])
+        p.call({"x": np.arange(4.0)})
+        assert not calls  # Mul lives only in the (dead) false branch
+
+    def test_fetching_dead_branch_errors(self):
+        p = import_graphdef(
+            self._cond_graph(True), fetches=["false_branch"])
+        with pytest.raises(GraphImportError, match="statically-dead"):
+            p.call({"x": np.arange(4.0)})
+
+    def test_const_returning_branches_via_control_edges(self):
+        """TF's cond ties const branch values to the Switch only through
+        control edges (^switch_t / ^switch_f pivots); deadness must
+        follow control edges or both Merge inputs stay live."""
+        g = GraphBuilder()
+        g.placeholder("x", "float64", [2])
+        g.const("pred", np.bool_(True))
+        g.op("Switch", "sw", ["x", "pred"])
+        g.op("Identity", "switch_f", ["sw:0"])
+        g.op("Identity", "switch_t", ["sw:1"])
+        g.const("cf", np.float64(-2.5))
+        g.const("ct", np.float64(7.5))
+        g.op("Identity", "fv", ["cf", "^switch_f"])
+        g.op("Identity", "tv", ["ct", "^switch_t"])
+        g.op("Merge", "m", ["fv", "tv"])
+        p = import_graphdef(g.to_bytes(), fetches=["m"])
+        assert float(np.asarray(p.call({"x": np.zeros(2)})["m"])) == 7.5
+
+    def test_nested_cond_in_dead_branch(self):
+        """An inner cond living entirely inside the outer's dead branch
+        must itself go dead (0 live Merge inputs -> propagate, not
+        raise)."""
+        g = GraphBuilder()
+        g.placeholder("x", "float64", [2])
+        g.const("outer_p", np.bool_(True))
+        g.op("Switch", "osw", ["x", "outer_p"])
+        # dead outer-false branch contains a whole inner cond
+        g.const("inner_p", np.bool_(False))
+        g.op("Switch", "isw", ["osw:0", "inner_p"])
+        g.op("Neg", "inf_", ["isw:0"])
+        g.op("Abs", "int_", ["isw:1"])
+        g.op("Merge", "im", ["inf_", "int_"])
+        # live outer-true branch
+        g.op("Mul", "tv", ["osw:1", g.const("three", np.float64(3.0))])
+        g.op("Merge", "om", ["im", "tv"])
+        p = import_graphdef(g.to_bytes(), fetches=["om"])
+        np.testing.assert_allclose(
+            np.asarray(p.call({"x": np.asarray([1.0, 2.0])})["om"]),
+            [3.0, 6.0])
+
+    def test_concrete_fed_predicate_specializes_eagerly(self):
+        """A pred fed as a concrete host value resolves per call (eager
+        eval sees real numpy, like constant folding does)."""
+        g = GraphBuilder()
+        g.placeholder("x", "float64", [4])
+        g.placeholder("p", "bool", [])
+        g.op("Switch", "sw", ["x", "p"])
+        g.op("Merge", "m", ["sw:0", "sw:1"])
+        p = import_graphdef(g.to_bytes(), fetches=["m"])
+        np.testing.assert_allclose(
+            np.asarray(p.call({"x": np.arange(4.0),
+                               "p": np.bool_(True)})["m"]),
+            np.arange(4.0))
+
+    def test_traced_predicate_rejected(self):
+        """Under jit (the verb path) the predicate is a tracer — the
+        static-cond contract must fail loudly, not silently pick."""
+        import jax
+
+        g = GraphBuilder()
+        g.placeholder("x", "float64", [4])
+        g.placeholder("p", "bool", [])
+        g.op("Switch", "sw", ["x", "p"])
+        g.op("Merge", "m", ["sw:0", "sw:1"])
+        p = import_graphdef(g.to_bytes(), fetches=["m"])
+        with pytest.raises(UnsupportedOpError, match="data-dependent"):
+            jax.jit(lambda x, pr: p.call({"x": x, "p": pr}))(
+                np.arange(4.0), np.bool_(True))

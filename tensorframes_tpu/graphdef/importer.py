@@ -33,6 +33,160 @@ _PLACEHOLDER_OPS = ("Placeholder", "PlaceholderV2", "PlaceholderWithDefault")
 # dead-branch sentinel for statically-resolved v1 conds (Switch/Merge)
 _DEAD = object()
 
+# flat output-tuple position of each named output arg, for the function-
+# body ref grammar ``node:out_arg:idx`` (multi-output ops only; a single
+# output arg resolves by idx alone — covers number_attr outputs like
+# Split's)
+_OUTPUT_ARGS = {
+    "TopKV2": ("values", "indices"),
+    "Switch": ("output_false", "output_true"),
+    "Merge": ("output", "value_index"),
+    "FusedBatchNorm": ("y", "batch_mean", "batch_variance",
+                       "reserve_space_1", "reserve_space_2"),
+    "FusedBatchNormV2": ("y", "batch_mean", "batch_variance",
+                         "reserve_space_1", "reserve_space_2"),
+    "FusedBatchNormV3": ("y", "batch_mean", "batch_variance",
+                         "reserve_space_1", "reserve_space_2",
+                         "reserve_space_3"),
+}
+
+_MAX_FUNC_DEPTH = 16
+
+
+def _func_attr(node: NodeDef, key: str) -> str:
+    av = node.attrs.get(key)
+    if av is None or av.kind != "func":
+        raise GraphImportError(
+            f"node {node.name!r} ({node.op}) is missing function attr "
+            f"{key!r}"
+        )
+    return av.value[0]
+
+
+def _static_bool_pred(pred, what: str):
+    """None when the predicate is traced (-> caller raises); else bool."""
+    try:
+        arr = np.asarray(pred)  # tracers refuse this
+    except Exception:
+        return None
+    if arr.dtype != np.bool_:
+        raise GraphImportError(f"{what} predicate has dtype {arr.dtype}; "
+                               f"expected bool")
+    return bool(arr)
+
+
+def _eval_function(graph: GraphDef, fname: str, args, depth: int):
+    """Inline-evaluate a library FunctionDef body (the branch functions
+    TF2 control flow calls): args bind to the signature's input_args,
+    body nodes evaluate through the op registry, and the signature's
+    output_args resolve through the ``ret`` map.  Returns the flat list
+    of output values."""
+    if depth > _MAX_FUNC_DEPTH:
+        raise GraphImportError(
+            f"function call depth exceeds {_MAX_FUNC_DEPTH} at {fname!r}"
+        )
+    fd = graph.functions.get(fname)
+    if fd is None:
+        raise GraphImportError(
+            f"GraphDef library has no function {fname!r}; functions: "
+            f"{sorted(graph.functions)}"
+        )
+    if len(args) != len(fd.input_args):
+        raise GraphImportError(
+            f"function {fname!r} takes {len(fd.input_args)} args, got "
+            f"{len(args)}"
+        )
+    env: Dict[str, Any] = {an: v for (an, _), v in zip(fd.input_args, args)}
+    nodes = {n.name: n for n in fd.nodes}
+
+    def resolve(ref: str):
+        parts = ref.split(":")
+        if len(parts) == 1:
+            if ref not in env:
+                raise GraphImportError(
+                    f"function {fname!r}: bare ref {ref!r} is not an "
+                    f"input arg"
+                )
+            return env[ref]
+        if len(parts) != 3:
+            raise GraphImportError(
+                f"function {fname!r}: malformed body ref {ref!r}"
+            )
+        node_name, out_arg, idx = parts[0], parts[1], int(parts[2])
+        if node_name not in env:
+            raise GraphImportError(
+                f"function {fname!r}: ref {ref!r} precedes its node "
+                f"(bodies must be topologically ordered)"
+            )
+        val = env[node_name]
+        node_op = nodes[node_name].op if node_name in nodes else None
+        names = _OUTPUT_ARGS.get(node_op)
+        if names is not None:
+            if out_arg not in names:
+                raise GraphImportError(
+                    f"function {fname!r}: {node_op} has no output arg "
+                    f"{out_arg!r} (ref {ref!r})"
+                )
+            flat = names.index(out_arg)
+        else:
+            flat = idx  # single output arg (possibly number_attr-sized)
+        if isinstance(val, tuple):
+            return val[flat]
+        if flat != 0:
+            raise GraphImportError(
+                f"function {fname!r}: node {node_name!r} is "
+                f"single-output, ref {ref!r}"
+            )
+        return val
+
+    for node in fd.nodes:  # FunctionDef bodies are serialized in topo order
+        if node.op == "Const":
+            av = node.attrs.get("value")
+            if av is None or not isinstance(av.value, TensorProto):
+                raise GraphImportError(
+                    f"function {fname!r}: Const {node.name!r} has no value"
+                )
+            env[node.name] = av.value.value
+            continue
+        if node.op in ("If", "StatelessIf"):
+            ins = [resolve(r) for r in node.inputs if not r.startswith("^")]
+            taken = _static_bool_pred(ins[0], f"{node.op} {node.name!r}")
+            if taken is None:
+                raise op_registry.UnsupportedOpError(
+                    f"{node.op} node {node.name!r} has a data-dependent "
+                    f"predicate; only constant-predicate conds are "
+                    f"supported"
+                )
+            branch = _func_attr(
+                node, "then_branch" if taken else "else_branch")
+            outs = _eval_function(graph, branch, ins[1:], depth + 1)
+            env[node.name] = outs[0] if len(outs) == 1 else tuple(outs)
+            continue
+        if node.op in ("PartitionedCall", "StatefulPartitionedCall"):
+            ins = [resolve(r) for r in node.inputs if not r.startswith("^")]
+            outs = _eval_function(
+                graph, _func_attr(node, "f"), ins, depth + 1)
+            env[node.name] = outs[0] if len(outs) == 1 else tuple(outs)
+            continue
+        impl = op_registry.REGISTRY.get(node.op)
+        if impl is None:
+            raise op_registry.UnsupportedOpError(
+                f"function {fname!r}: op {node.op!r} (node "
+                f"{node.name!r}) has no JAX lowering"
+            )
+        ins = [resolve(r) for r in node.inputs if not r.startswith("^")]
+        env[node.name] = impl(ins, node.attrs)
+
+    out_vals = []
+    for out_arg, _ in fd.output_args:
+        ref = fd.ret.get(out_arg)
+        if ref is None:
+            raise GraphImportError(
+                f"function {fname!r}: ret map lacks output {out_arg!r}"
+            )
+        out_vals.append(resolve(ref))
+    return out_vals
+
 
 class GraphImportError(ValueError):
     """The GraphDef cannot be lowered (unknown op, bad fetch, cycle...)."""
@@ -284,20 +438,14 @@ def import_graphdef(
                 if data is _DEAD or pred is _DEAD:
                     cache[name] = _DEAD  # a nested cond in a dead branch
                     continue
-                try:
-                    pred_arr = np.asarray(pred)  # tracers refuse this
-                except Exception:
+                taken = _static_bool_pred(
+                    pred, f"Switch node {name!r}")
+                if taken is None:
                     raise op_registry.UnsupportedOpError(
                         f"Switch node {name!r} has a data-dependent "
                         f"predicate; only constant-predicate conds (the "
                         f"frozen-graph form) are supported"
-                    ) from None
-                if pred_arr.dtype != np.bool_:
-                    raise GraphImportError(
-                        f"Switch node {name!r} predicate has dtype "
-                        f"{pred_arr.dtype}; expected bool"
                     )
-                taken = bool(pred_arr)
                 # output:0 = false branch, output:1 = true branch
                 cache[name] = (
                     _DEAD if taken else data,
@@ -333,6 +481,43 @@ def import_graphdef(
                         f"Const node {name!r} has no tensor value"
                     )
                 cache[name] = av.value.value  # host numpy — const folding
+                continue
+            if node.op in ("If", "StatelessIf"):
+                # TF2 control flow: branch FunctionDefs called by name —
+                # same static-predicate contract as v1 Switch/Merge
+                ins = []
+                for ref in node.inputs:
+                    rn, ri = _split_ref(ref)
+                    if ri != -1:
+                        ins.append(_pick(rn, cache[rn], ri))
+                if any(v is _DEAD for v in ins):
+                    cache[name] = _DEAD  # sits in a dead v1 branch
+                    continue
+                taken = _static_bool_pred(
+                    ins[0], f"{node.op} node {name!r}")
+                if taken is None:
+                    raise op_registry.UnsupportedOpError(
+                        f"{node.op} node {name!r} has a data-dependent "
+                        f"predicate; only constant-predicate conds (the "
+                        f"frozen-graph form) are supported"
+                    )
+                branch = _func_attr(
+                    node, "then_branch" if taken else "else_branch")
+                outs = _eval_function(graph, branch, ins[1:], 1)
+                cache[name] = outs[0] if len(outs) == 1 else tuple(outs)
+                continue
+            if node.op in ("PartitionedCall", "StatefulPartitionedCall"):
+                ins = []
+                for ref in node.inputs:
+                    rn, ri = _split_ref(ref)
+                    if ri != -1:
+                        ins.append(_pick(rn, cache[rn], ri))
+                if any(v is _DEAD for v in ins):
+                    cache[name] = _DEAD  # sits in a dead v1 branch
+                    continue
+                outs = _eval_function(
+                    graph, _func_attr(node, "f"), ins, 1)
+                cache[name] = outs[0] if len(outs) == 1 else tuple(outs)
                 continue
             if node.op in _PLACEHOLDER_OPS:
                 if node.op == "PlaceholderWithDefault" and node.inputs:

@@ -180,7 +180,8 @@ AttrVal = Union[bytes, int, float, bool, Shape, TensorProto, list, None]
 
 @dataclasses.dataclass
 class AttrValue:
-    kind: str  # 's','i','f','b','type','shape','tensor','list','none'
+    kind: str  # 's','i','f','b','type','shape','tensor','list',
+    #            'type_list','func','none'
     value: AttrVal
 
     @staticmethod
@@ -200,6 +201,22 @@ class AttrValue:
                 return AttrValue("shape", parse_shape(v))
             if field == 8:
                 return AttrValue("tensor", TensorProto.parse(v))
+            if field == 10:  # NameAttrList — branch functions of If/While
+                fname = ""
+                fattrs: Dict[str, "AttrValue"] = {}
+                for f2, _, v2 in wire.fields(v):
+                    if f2 == 1:
+                        fname = v2.decode()
+                    elif f2 == 2:
+                        k2 = ""
+                        av2 = AttrValue("none", None)
+                        for f3, _, v3 in wire.fields(v2):
+                            if f3 == 1:
+                                k2 = v3.decode()
+                            elif f3 == 2:
+                                av2 = AttrValue.parse(v3)
+                        fattrs[k2] = av2
+                return AttrValue("func", (fname, fattrs))
             if field == 1:  # ListValue
                 items: List = []
                 kind = "list"
@@ -282,6 +299,16 @@ class AttrValue:
                         f"cannot encode list attr item {type(it).__name__}"
                     )
             wire.write_len_field(out, 1, bytes(lst))
+        elif self.kind == "func":
+            fname, fattrs = self.value
+            msg = bytearray()
+            wire.write_len_field(msg, 1, fname.encode())
+            for k in sorted(fattrs):
+                entry = bytearray()
+                wire.write_len_field(entry, 1, k.encode())
+                wire.write_len_field(entry, 2, fattrs[k].encode())
+                wire.write_len_field(msg, 2, bytes(entry))
+            wire.write_len_field(out, 10, bytes(msg))
         elif self.kind == "type_list":
             # ListValue.type: `repeated DataType type = 6 [packed = true]`
             packed = bytearray()
@@ -350,21 +377,108 @@ class NodeDef:
 
 
 @dataclasses.dataclass
+class FunctionDef:
+    """A library function (function.proto) — the body TF2 control flow
+    (``StatelessIf``/``If``/``While``) calls by name.
+
+    ``input_args``/``output_args`` are the signature's ArgDef names in
+    declaration order (with TF dtype enums where declared); body node
+    inputs use the function-ref grammar ``node:out_arg:idx`` for node
+    outputs and bare names for input args; ``ret`` maps each output arg
+    to such a ref."""
+
+    name: str
+    input_args: List[Tuple[str, int]]
+    output_args: List[Tuple[str, int]]
+    nodes: List[NodeDef]
+    ret: Dict[str, str]
+
+    @staticmethod
+    def parse(buf: bytes) -> "FunctionDef":
+        name = ""
+        input_args: List[Tuple[str, int]] = []
+        output_args: List[Tuple[str, int]] = []
+        nodes: List[NodeDef] = []
+        ret: Dict[str, str] = {}
+        for field, wt, v in wire.fields(buf):
+            if field == 1 and wt == wire.WIRE_LEN:  # signature: OpDef
+                for f2, _, v2 in wire.fields(v):
+                    if f2 == 1:
+                        name = v2.decode()
+                    elif f2 in (2, 3):  # input_arg / output_arg: ArgDef
+                        an, at = "", 0
+                        for f3, _, v3 in wire.fields(v2):
+                            if f3 == 1:
+                                an = v3.decode()
+                            elif f3 == 3:
+                                at = int(v3)
+                        (input_args if f2 == 2 else output_args).append(
+                            (an, at)
+                        )
+            elif field == 3 and wt == wire.WIRE_LEN:
+                nodes.append(NodeDef.parse(v))
+            elif field == 4 and wt == wire.WIRE_LEN:  # ret map entry
+                k = rv = ""
+                for f2, _, v2 in wire.fields(v):
+                    if f2 == 1:
+                        k = v2.decode()
+                    elif f2 == 2:
+                        rv = v2.decode()
+                ret[k] = rv
+        return FunctionDef(name, input_args, output_args, nodes, ret)
+
+    def encode(self) -> bytes:
+        sig = bytearray()
+        wire.write_len_field(sig, 1, self.name.encode())
+        for f2, args in ((2, self.input_args), (3, self.output_args)):
+            for an, at in args:
+                arg = bytearray()
+                wire.write_len_field(arg, 1, an.encode())
+                if at:
+                    wire.write_varint_field(arg, 3, at)
+                wire.write_len_field(sig, f2, bytes(arg))
+        out = bytearray()
+        wire.write_len_field(out, 1, bytes(sig))
+        for n in self.nodes:
+            wire.write_len_field(out, 3, n.encode())
+        for k in sorted(self.ret):
+            entry = bytearray()
+            wire.write_len_field(entry, 1, k.encode())
+            wire.write_len_field(entry, 2, self.ret[k].encode())
+            wire.write_len_field(out, 4, bytes(entry))
+        return bytes(out)
+
+
+@dataclasses.dataclass
 class GraphDef:
     nodes: List[NodeDef]
+    functions: Dict[str, FunctionDef] = dataclasses.field(
+        default_factory=dict
+    )
 
     @staticmethod
     def parse(buf: bytes) -> "GraphDef":
         nodes = []
+        functions: Dict[str, FunctionDef] = {}
         for field, wt, v in wire.fields(buf):
             if field == 1 and wt == wire.WIRE_LEN:
                 nodes.append(NodeDef.parse(v))
-        return GraphDef(nodes)
+            elif field == 2 and wt == wire.WIRE_LEN:  # FunctionDefLibrary
+                for f2, wt2, v2 in wire.fields(v):
+                    if f2 == 1 and wt2 == wire.WIRE_LEN:
+                        fd = FunctionDef.parse(v2)
+                        functions[fd.name] = fd
+        return GraphDef(nodes, functions)
 
     def encode(self) -> bytes:
         out = bytearray()
         for n in self.nodes:
             wire.write_len_field(out, 1, n.encode())
+        if self.functions:
+            lib = bytearray()
+            for fname in sorted(self.functions):
+                wire.write_len_field(lib, 1, self.functions[fname].encode())
+            wire.write_len_field(out, 2, bytes(lib))
         return bytes(out)
 
     def node_map(self) -> Dict[str, NodeDef]:

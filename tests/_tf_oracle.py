@@ -430,6 +430,30 @@ def case_plumbing():
     ]
 
 
+def case_cond_v2():
+    """TF2 control flow: tf.cond emits StatelessIf + branch FunctionDefs
+    in the graph library (the form modern frozen graphs carry).  Must
+    run BEFORE case_cond, which disables control-flow v2 process-wide."""
+    tf1.enable_control_flow_v2()
+    r = _rng(13)
+    x_v = r.randn(3, 4).astype(np.float32)
+    x = tf1.placeholder(tf.float32, [3, 4], name="x")
+    t = tf.cond(tf.constant(True), lambda: x + 1.0, lambda: x * 2.0)
+    f = tf.cond(tf.constant(False),
+                lambda: tf.raw_ops.Softmax(logits=x),
+                lambda: x - 3.0)
+    # nested: inner cond inside the taken branch
+    n = tf.cond(tf.constant(True),
+                lambda: tf.cond(tf.constant(False),
+                                lambda: x * 10.0, lambda: x + 0.5),
+                lambda: x)
+    tf.raw_ops.Identity(input=t, name="v2_true")
+    tf.raw_ops.Identity(input=f, name="v2_false")
+    tf.raw_ops.Identity(input=n, name="v2_nested")
+    tf.raw_ops.AddV2(x=t, y=f, name="v2_after")
+    return {"x": x_v}, ["v2_true", "v2_false", "v2_nested", "v2_after"]
+
+
 def case_cond():
     """v1 control flow with constant predicates — the Switch/Merge
     residue a frozen tf.cond leaves when its predicate froze to a Const
@@ -466,6 +490,7 @@ BUILD_CASES = {
     "convpool": case_convpool,
     "gencast": case_gencast,
     "plumbing": case_plumbing,
+    "cond_v2": case_cond_v2,
     "cond": case_cond,
 }
 

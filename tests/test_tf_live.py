@@ -49,7 +49,7 @@ _ORACLE = os.path.join(os.path.dirname(__file__), "_tf_oracle.py")
 BUILD_CASE_NAMES = [
     "arith", "mathfns", "acts", "cmpsel", "linalg",
     "reduce", "shapes", "slicing", "convpool", "gencast", "plumbing",
-    "cond",
+    "cond", "cond_v2",
 ]
 # float comparison tolerance per case (ints/bools are always exact)
 _TOL = {

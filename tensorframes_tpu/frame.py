@@ -411,22 +411,33 @@ class TensorFrame:
         those would silently truncate the stored values (device_put
         canonicalises to 32-bit) while the schema still claims 64; the host
         copy remains authoritative and verbs keep casting per block.  Cast
-        the column to a 32-bit dtype first to cache it."""
-        import jax
+        the column to a 32-bit dtype first to cache it.
 
-        cols = []
+        Transfers are issued through ``ops.prefetch.stage_columns`` — the
+        engine's one transfer-issue policy point — so the per-column
+        ``device_put`` calls queue back to back on the link.  Once cached,
+        the verbs' prefetch/donation machinery treats the columns as
+        shared device state: never streamed, never donated
+        (``ops/prefetch.py``'s safety contract)."""
+        from .ops import prefetch
+
+        host: Dict[str, Any] = {}
         for c in self._columns:
             st = c.info.scalar_type
-            if (
+            if not (
                 c.is_device
                 or c.is_ragged
                 or not st.device_ok
                 or dtypes.coerce(st) is not st
             ):
-                cols.append(c)
-            else:
-                data = jax.device_put(c.data, device)
-                cols.append(Column(c.info, data))
+                host[c.info.name] = c.data
+        staged = prefetch.stage_columns(host, device)
+        cols = [
+            Column(c.info, staged[c.info.name])
+            if c.info.name in staged
+            else c
+            for c in self._columns
+        ]
         return TensorFrame(cols, self._offsets)
 
     def uncache(self) -> "TensorFrame":

@@ -72,7 +72,14 @@ def _static_bool_pred(pred, what: str):
     if arr.dtype != np.bool_:
         raise GraphImportError(f"{what} predicate has dtype {arr.dtype}; "
                                f"expected bool")
-    return bool(arr)
+    if arr.size != 1:
+        # bool(arr) on a multi-element array would raise numpy's opaque
+        # "truth value of an array is ambiguous" — name the node instead
+        raise GraphImportError(
+            f"{what} predicate has shape {arr.shape}; expected a scalar "
+            f"bool (a control-flow predicate must be a single value)"
+        )
+    return bool(arr.reshape(()))
 
 
 def _eval_function(graph: GraphDef, fname: str, args, depth: int):

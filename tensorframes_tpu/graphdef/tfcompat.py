@@ -133,7 +133,11 @@ def complete_for_tf(graph: GraphDef) -> GraphDef:
             return None
         if idx < len(dts):
             return dts[idx]
-        return dts[0] if dts else None
+        # out-of-range output index (e.g. the producer's arity was
+        # under-estimated because num/num_split was absent): guessing
+        # dts[0] could stamp a WRONG dtype attr into the emitted NodeDef;
+        # best-effort means leave the attr unset instead (ADVICE r5)
+        return None
 
     new_nodes: List[NodeDef] = []
     for old in _topo(graph.nodes):
@@ -343,4 +347,8 @@ def complete_for_tf(graph: GraphDef) -> GraphDef:
     # preserve the caller's node order (topo order was only for inference)
     order = {n.name: i for i, n in enumerate(graph.nodes)}
     new_nodes.sort(key=lambda n: order[n.name])
-    return GraphDef(new_nodes)
+    # the FunctionDefLibrary passes through untouched: dropping it would
+    # leave If/StatelessIf/PartitionedCall nodes with dangling function
+    # refs that real TF rejects (ADVICE r5 medium).  Function bodies are
+    # not attr-completed — TF-built FunctionDefs already carry their attrs
+    return GraphDef(new_nodes, dict(graph.functions))

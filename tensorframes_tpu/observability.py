@@ -94,6 +94,11 @@ class _Span:
         self.phases[phase] = self.phases.get(phase, 0.0) + (now - self._last)
         self._last = now
 
+    def annotate(self, key: str, value: Any) -> None:
+        """Attach structured metadata to this span's record (e.g. the
+        engine's prefetch/overlap stats, a roofline digest)."""
+        self.meta[key] = value
+
     def _finish(self) -> Dict[str, Any]:
         total = time.perf_counter() - self._t0
         rec = {
@@ -120,6 +125,9 @@ class _NullSpan:
     __slots__ = ()
 
     def mark(self, phase: str) -> None:  # noqa: D102
+        pass
+
+    def annotate(self, key: str, value: Any) -> None:  # noqa: D102
         pass
 
 

@@ -43,7 +43,7 @@ from ..frame import Column, TensorFrame
 from ..program import Program
 from ..schema import ColumnInfo, Schema
 from ..shape import Shape, ShapeError, UNKNOWN
-from . import segment_compile, validation
+from . import prefetch, segment_compile, validation
 from .validation import ValidationError
 
 
@@ -208,6 +208,34 @@ class Executor:
     def _run_block_program(self, program: Program, inputs) -> Dict[str, Any]:
         return program.jitted()(inputs)
 
+    # -- donated entries (prefetch path) ------------------------------------
+    # A donating executable invalidates its input buffers, letting XLA
+    # reuse them for outputs: with the Prefetcher's bounded window the
+    # steady-state HBM footprint of uncached ingestion is <= depth input
+    # blocks regardless of frame size.  ONLY freshly staged buffers may
+    # flow through these (prefetch.py's no-use-after-donate contract);
+    # device-resident (cached/chained) columns keep the plain entries.
+
+    def _block_run(self, program: Program, donate: bool):
+        if not donate:
+            return program.jitted()
+        return program.cached_jit(
+            ("map_blocks", "donated"),
+            lambda: lambda ins, ps: program.call(ins, ps),
+            donate_argnums=(0,),
+        )
+
+    def _rows_run(self, program: Program, donate: bool):
+        if not donate:
+            return program.vmapped()
+        return program.cached_jit(
+            ("map_rows", "donated"),
+            lambda: lambda ins, ps: jax.vmap(
+                lambda i: program.call(i, ps), in_axes=(0,)
+            )(ins),
+            donate_argnums=(0,),
+        )
+
     # h2d streaming granularity for uncached blocks (VERDICT r4 weak #3):
     # a block whose host->device transfer exceeds ~2 chunks is split into
     # row slices, each device_put + dispatched separately, so chunk k+1's
@@ -270,30 +298,55 @@ class Executor:
         return per
 
     def _run_block_streamed(
-        self, program: Program, block, infos, per: int, run=None
+        self,
+        program: Program,
+        block,
+        infos,
+        per: int,
+        rows_level: bool = False,
+        pf_stats: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Chunked h2d + dispatch: equal row slices (last may be short, so
         at most two executables trace), outputs concatenated on device.
-        ``run`` overrides the executable (map_rows passes its vmapped
-        entry)."""
+
+        The chunks run through a :class:`prefetch.Prefetcher`: chunk k+1's
+        cast + ``device_put`` happen on the staging thread while chunk k's
+        compute dispatches, and each chunk's staged buffers are donated to
+        the executable (fresh per chunk by construction), so HBM holds at
+        most the prefetch window of input chunks.  ``rows_level`` picks the
+        vmapped cell entry (map_rows); ``pf_stats`` (a caller-LOCAL dict,
+        never a live Prefetcher's stats — the outer staging thread writes
+        those concurrently) accumulates the chunk prefetcher's totals for
+        the caller's span record."""
         names = program.input_names
         arrays = {}
         n_rows = 0
         for nm in names:
-            st = dtypes.coerce(infos[nm].scalar_type)
-            arr = np.asarray(block[program.column_for_input(nm)])
-            if arr.dtype != st.np_dtype:
-                arr = arr.astype(st.np_dtype)
-            arrays[nm] = arr
-            n_rows = arr.shape[0]
-        outs: List[Dict[str, Any]] = []
-        run = run if run is not None else program.jitted()
-        for start in range(0, n_rows, per):
-            sl = slice(start, min(start + per, n_rows))
-            inputs = {
-                nm: jax.device_put(arrays[nm][sl]) for nm in names
+            arrays[nm] = np.asarray(block[program.column_for_input(nm)])
+            n_rows = arrays[nm].shape[0]
+        starts = list(range(0, n_rows, per))
+
+        def stage(k):
+            sl = slice(starts[k], min(starts[k] + per, n_rows))
+            return {
+                nm: self._device_value(
+                    arrays[nm][sl], dtypes.coerce(infos[nm].scalar_type)
+                )
+                for nm in names
             }
-            outs.append(run(inputs))
+
+        donate = prefetch.donate_inputs()
+        run = (
+            self._rows_run(program, donate)
+            if rows_level
+            else self._block_run(program, donate)
+        )
+        pf = prefetch.Prefetcher(stage, len(starts))
+        outs: List[Dict[str, Any]] = [run(inputs) for inputs in pf]
+        if pf_stats is not None:
+            pf_stats["items"] += pf.stats["items"]
+            pf_stats["stage_s"] += pf.stats["stage_s"]
+            pf_stats["wait_s"] += pf.stats["wait_s"]
         return {
             k: jnp.concatenate([o[k] for o in outs]) for k in outs[0]
         }
@@ -313,7 +366,8 @@ class Executor:
         (output shapes are static, so row-count validation needs no data).
         ``host_stage``: input name -> host fn(cells) -> [rows, *cell] array,
         run per block before the device program (binary decode, bucketing);
-        block N+1's host stage overlaps block N's device compute."""
+        it executes on the prefetch staging thread, so block N+1's host
+        stage AND h2d transfer overlap block N's device compute."""
         host_stage = _with_prelude(program, host_stage)
         with observability.verb_span(
             "map_blocks", frame.num_rows, frame.num_blocks
@@ -322,43 +376,150 @@ class Executor:
                 program, frame, "map_blocks", host_staged=host_stage or ()
             )
             span.mark("validate")
-            out_blocks: List[Dict[str, Any]] = []
-            for bi in range(frame.num_blocks):
-                block = frame.block(bi)
-                n_rows = len(next(iter(block.values())))
-                per = self._stream_plan(program, block, infos, host_stage)
-                if per is not None:
-                    outs = self._run_block_streamed(
-                        program, block, infos, per
-                    )
-                else:
-                    inputs = self._device_inputs(
-                        program, block, infos, host_stage
-                    )
-                    outs = self._run_block_program(program, inputs)
-                if not trim:
-                    for name, v in outs.items():
-                        if v.ndim == 0 or v.shape[0] != n_rows:
-                            raise ValidationError(
-                                f"map_blocks: output {name!r} has shape "
-                                f"{v.shape} but the input block has {n_rows} "
-                                f"rows; a non-trimmed map must preserve the "
-                                f"row count (use map_blocks_trimmed to "
-                                f"change it)."
-                            )
-                else:
-                    counts = {
-                        v.shape[0] if v.ndim else None for v in outs.values()
-                    }
-                    if len(counts) != 1 or None in counts:
-                        raise ValidationError(
-                            f"map_blocks_trimmed: outputs disagree on row "
-                            f"count: { {k: v.shape for k, v in outs.items()} }"
-                        )
-                _check_shape_hints(program, outs, "map_blocks", cell_level=False)
-                out_blocks.append(outs)
+            out_blocks = self._map_dispatch(
+                program, frame, infos, host_stage, span,
+                rows_level=False, trim=trim,
+            )
             span.mark("dispatch")
             return self._build_map_output(frame, out_blocks, trim)
+
+    def _map_dispatch(
+        self,
+        program: Program,
+        frame: TensorFrame,
+        infos,
+        host_stage,
+        span,
+        rows_level: bool,
+        trim: bool,
+    ) -> List[Dict[str, Any]]:
+        """Shared block loop of the two map verbs, prefetched: up to
+        ``TFS_PREFETCH_BLOCKS`` blocks are staged (host cast + host_stage +
+        async ``device_put``) on a worker thread ahead of the compute
+        dispatches, and blocks whose every input buffer was freshly staged
+        run through a donating executable (``_block_run``/``_rows_run``) so
+        steady-state HBM holds at most the prefetch window of input blocks.
+        Blocks with device-resident inputs (cached frames, chained verbs)
+        keep the plain non-donating entries — donating a shared column
+        buffer would corrupt the frame (prefetch.py's safety contract).
+        Streamed blocks (``_stream_plan``) prefetch+donate at chunk
+        granularity instead."""
+        verb = "map_rows" if rows_level else "map_blocks"
+        # plan on the caller thread: _stream_plan may trace (row-
+        # independence proof); all jit entry points stay off the worker
+        plans = [
+            self._stream_plan(
+                program, frame.block(bi), infos, host_stage,
+                check_independence=not rows_level,
+            )
+            for bi in range(frame.num_blocks)
+        ]
+        donate = prefetch.donate_inputs()
+        # residency is a COLUMN property (one array sliced per block), so
+        # freshness is decided once per frame, on the consumer thread.
+        # It covers EVERY column, not just the program's inputs, because
+        # the worker's ``frame.block()`` slices all of them — and slicing
+        # a device column (jax.Array.__getitem__) is a jit entry point,
+        # which the Prefetcher contract keeps off the worker.  Donation
+        # eligibility only needs the program's input columns host-side,
+        # and all-host is a superset of that.
+        fresh = all(
+            not frame.column(ci.name).is_device for ci in frame.schema
+        )
+        # only spin up a staging thread when some block will actually
+        # stage on it; otherwise (device-resident frame, or every block
+        # streamed at chunk level) keep the plain consumer loop
+        to_stage = fresh and any(p is None for p in plans)
+
+        def stage(bi):
+            if plans[bi] is not None:
+                return None  # streamed inline, chunk-level prefetch
+            return self._device_inputs(
+                program, frame.block(bi), infos, host_stage
+            )
+
+        pf = prefetch.Prefetcher(stage, frame.num_blocks) if to_stage else None
+        # chunk-prefetcher stats accumulate here, NOT into pf.stats: the
+        # block staging thread writes pf.stats concurrently with this
+        # consumer loop, and += on a shared dict entry would lose updates
+        chunk_stats = {"items": 0, "stage_s": 0.0, "wait_s": 0.0}
+        block_sizes = frame.block_sizes
+        out_blocks: List[Dict[str, Any]] = []
+        items = pf if pf is not None else (
+            None for _ in range(frame.num_blocks)
+        )
+        for bi, staged in enumerate(items):
+            n_rows = block_sizes[bi]
+            if plans[bi] is not None:
+                outs = self._run_block_streamed(
+                    program, frame.block(bi), infos, plans[bi],
+                    rows_level=rows_level, pf_stats=chunk_stats,
+                )
+            else:
+                inputs = (
+                    staged
+                    if staged is not None
+                    else self._device_inputs(  # device-resident block
+                        program, frame.block(bi), infos, host_stage
+                    )
+                )
+                if rows_level:
+                    outs = self._rows_run(program, donate and fresh)(inputs)
+                elif donate and fresh:
+                    outs = self._block_run(program, True)(inputs)
+                else:
+                    outs = self._run_block_program(program, inputs)
+                del inputs, staged  # drop staged refs (donation hygiene)
+            if rows_level:
+                pass  # row programs are per-cell; no block row-count check
+            elif not trim:
+                for name, v in outs.items():
+                    if v.ndim == 0 or v.shape[0] != n_rows:
+                        raise ValidationError(
+                            f"map_blocks: output {name!r} has shape "
+                            f"{v.shape} but the input block has {n_rows} "
+                            f"rows; a non-trimmed map must preserve the "
+                            f"row count (use map_blocks_trimmed to "
+                            f"change it)."
+                        )
+            else:
+                counts = {
+                    v.shape[0] if v.ndim else None for v in outs.values()
+                }
+                if len(counts) != 1 or None in counts:
+                    raise ValidationError(
+                        f"map_blocks_trimmed: outputs disagree on row "
+                        f"count: { {k: v.shape for k, v in outs.items()} }"
+                    )
+            _check_shape_hints(program, outs, verb, cell_level=rows_level)
+            out_blocks.append(outs)
+        # the loop consumed every item, so the staging thread has finished
+        # (its last stats write happened-before the last queue get): pf.stats
+        # is safe to read and merge with the chunk prefetchers' totals.
+        # ``items`` counts buffers actually staged ahead: whole blocks the
+        # worker staged plus streamed chunks — never the trivial None
+        # passes for streamed/device-resident blocks
+        staged_blocks = (
+            sum(1 for p in plans if p is None) if pf is not None else 0
+        )
+        stage_s = (pf.stats["stage_s"] if pf else 0.0) + chunk_stats["stage_s"]
+        wait_s = (pf.stats["wait_s"] if pf else 0.0) + chunk_stats["wait_s"]
+        span.annotate(
+            "prefetch",
+            {
+                "items": staged_blocks + chunk_stats["items"],
+                "depth": prefetch.prefetch_depth(),
+                "stage_s": round(stage_s, 6),
+                "wait_s": round(wait_s, 6),
+                "overlap_ratio": round(
+                    prefetch.overlap_ratio(stage_s, wait_s), 4
+                ),
+                # whether donation actually applied to this verb's blocks,
+                # not just the knob: a device-resident frame never donates
+                "donate": donate and fresh,
+            },
+        )
+        return out_blocks
 
     def map_rows(
         self,
@@ -393,28 +554,13 @@ class Executor:
                 )
                 span.mark("dispatch")
                 return out
-            vmapped = program.vmapped()
-            out_blocks: List[Dict[str, Any]] = []
-            for bi in range(frame.num_blocks):
-                block = frame.block(bi)
-                # row programs are row-independent BY CONSTRUCTION (the
-                # cell program is vmapped), so big uncached blocks always
-                # stream their h2d in chunks
-                per = self._stream_plan(
-                    program, block, infos, host_stage,
-                    check_independence=False,
-                )
-                if per is not None:
-                    outs = self._run_block_streamed(
-                        program, block, infos, per, run=vmapped
-                    )
-                else:
-                    inputs = self._device_inputs(
-                        program, block, infos, host_stage
-                    )
-                    outs = vmapped(inputs)
-                _check_shape_hints(program, outs, "map_rows", cell_level=True)
-                out_blocks.append(outs)
+            # row programs are row-independent BY CONSTRUCTION (the cell
+            # program is vmapped), so big uncached blocks always stream
+            # their h2d in chunks (check_independence=False in the plan)
+            out_blocks = self._map_dispatch(
+                program, frame, infos, host_stage, span,
+                rows_level=True, trim=False,
+            )
             span.mark("dispatch")
             return self._build_map_output(frame, out_blocks, trim=False)
 

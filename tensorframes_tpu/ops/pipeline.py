@@ -65,7 +65,7 @@ from ..frame import TensorFrame, is_device_array
 from ..program import Program
 from ..schema import ColumnInfo, Schema
 from ..shape import Shape, UNKNOWN
-from . import validation
+from . import prefetch, validation
 from .engine import _DEFAULT
 from .validation import ValidationError
 
@@ -134,7 +134,9 @@ class Pipeline:
         self._visible = visible
         self._from_source = from_source or {}
         self._row_stage = row_stage  # terminal produces a row, not a frame
-        self._compiled = None
+        # keyed by donate flag: a host-sourced frame stages fresh entry
+        # buffers per call and may donate them; a cached frame must not
+        self._compiled: Dict[bool, Any] = {}
         self._iter_compiled: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------ builders --
@@ -566,13 +568,16 @@ class Pipeline:
         with observability.verb_span(
             "pipeline", self._frame.num_rows, self._frame.num_blocks
         ) as span:
-            if self._compiled is None:
-                self._compiled = jax.jit(
-                    lambda cols, params_list: self._body(cols, params_list)
+            cols, donate = self._entry_cols()
+            if donate not in self._compiled:
+                self._compiled[donate] = jax.jit(
+                    lambda cols, params_list: self._body(cols, params_list),
+                    **({"donate_argnums": (0,)} if donate else {}),
                 )
-            cols = self._entry_cols()
             span.mark("validate")
-            out = self._compiled(cols, self._params_list())
+            span.annotate("donate_entry", donate)
+            out = self._compiled[donate](cols, self._params_list())
+            del cols  # staged entry buffers: donated or dead either way
             span.mark("dispatch")
             if self._row_stage:
                 return out
@@ -592,8 +597,20 @@ class Pipeline:
                     )
             return frame
 
-    def _entry_cols(self) -> Dict[str, Any]:
+    def _entry_cols(self) -> Tuple[Dict[str, Any], bool]:
+        """Source columns for the trace, staged onto the device.
+
+        Host columns are cast then ``device_put`` back to back (async —
+        the per-column transfers queue together on the link instead of
+        being issued lazily by the jit call).  Returns ``(cols, donate)``:
+        ``donate`` is True when every staged buffer is a fresh transfer
+        this call created, so ``run``/``iterate`` may donate the entry
+        arguments and the staged copies die with the dispatch (steady-
+        state HBM holds one staged set).  Device-resident (cached)
+        columns are shared frame state and disable donation; mesh
+        placement keeps its own sharded path."""
         cols = {}
+        donate = True
         for name in self._needed_source_cols():
             c = self._frame.column(name)
             data = c.data
@@ -602,12 +619,18 @@ class Pipeline:
                 data = np.asarray(data)
                 if data.dtype != st.np_dtype:
                     data = data.astype(st.np_dtype)
+            else:
+                donate = False
             if self._mesh_mode:
                 # rows land sharded over the engine's data axis; GSPMD
                 # propagates from these input shardings through the trace
                 data = self._engine._place_rows(jnp.asarray(data))
             cols[name] = data
-        return cols
+        if self._mesh_mode or not cols:
+            return cols, False
+        return prefetch.stage_columns(cols), (
+            donate and prefetch.donate_inputs()
+        )
 
     def collect(self):
         """``run()`` + host materialisation (the one sync)."""
@@ -667,7 +690,8 @@ class Pipeline:
             for i in hits:
                 targets.append((i, param_name, out_name))
 
-        key = (num_steps, tuple(sorted(carry.items())), tuple(collect))
+        cols, donate = self._entry_cols()
+        key = (num_steps, tuple(sorted(carry.items())), tuple(collect), donate)
         if key not in self._iter_compiled:
 
             def loop(cols, params_list):
@@ -709,14 +733,17 @@ class Pipeline:
                     finals[pname] = final_pl[i][pname]
                 return finals, hist
 
-            self._iter_compiled[key] = jax.jit(loop)
+            self._iter_compiled[key] = jax.jit(
+                loop, **({"donate_argnums": (0,)} if donate else {})
+            )
 
         with observability.verb_span(
             "pipeline.iterate", self._frame.num_rows, self._frame.num_blocks
         ) as span:
-            cols = self._entry_cols()
             span.mark("validate")
+            span.annotate("donate_entry", donate)
             finals, hist = self._iter_compiled[key](cols, self._params_list())
+            del cols
             span.mark("dispatch")
             # resume contract: stage programs pick up the final params
             for i, pname, _ in targets:

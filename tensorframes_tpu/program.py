@@ -438,21 +438,23 @@ class Program:
     # does not pin their executables forever
     _DERIVED_CAP = 32
 
-    def cached_jit(self, key, build_raw):
-        """Memoize ``jax.jit(build_raw())`` with live params bound.
+    def cached_jit(self, key, build_raw, **jit_kwargs):
+        """Memoize ``jax.jit(build_raw(), **jit_kwargs)`` with live params
+        bound.
 
         The verb engines build per-verb wrappers (pairwise folds, block
-        reducers, shard_maps) whose last positional argument is the params
-        dict; caching them here keyed by verb/mode/mesh means repeated verb
-        invocations on the same Program reuse one jit cache instead of
-        re-tracing per call, and ``update_params`` takes effect without
-        recompiling.  ``build_raw`` returns the raw traceable
-        ``fn(*args, params)``."""
+        reducers, shard_maps, donated prefetch entries) whose last
+        positional argument is the params dict; caching them here keyed by
+        verb/mode/mesh means repeated verb invocations on the same Program
+        reuse one jit cache instead of re-tracing per call, and
+        ``update_params`` takes effect without recompiling.  ``build_raw``
+        returns the raw traceable ``fn(*args, params)``; ``jit_kwargs``
+        (e.g. ``donate_argnums``) must be part of ``key`` when they vary."""
         if key not in self._derived:
             while len(self._derived) >= self._DERIVED_CAP:
                 self._derived.pop(next(iter(self._derived)))
             self._derived[key] = self._bind_live_params(
-                jax.jit(build_raw())
+                jax.jit(build_raw(), **jit_kwargs)
             )
         return self._derived[key]
 

@@ -23,11 +23,14 @@ inherits the params' sharding under jit.
 from __future__ import annotations
 
 import dataclasses
+import gc
 import logging
-from typing import Any, Dict, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
@@ -762,3 +765,179 @@ def make_train_step(
         return params, opt_state, loss
 
     return train_step, tx
+
+
+# ---------------------------------------------------------------------------
+# MFU frontier sweep (round 6)
+# ---------------------------------------------------------------------------
+
+
+def hbm_high_water(device=None) -> Optional[int]:
+    """Peak bytes in use on ``device`` per the PJRT allocator (the
+    process-lifetime high-water mark, so it is monotone across a sweep),
+    or None when the backend exposes no memory stats (XLA:CPU)."""
+    if device is None:
+        device = jax.local_devices()[0]
+    stats_fn = getattr(device, "memory_stats", None)
+    stats = stats_fn() if stats_fn is not None else None
+    if not stats:
+        return None
+    return stats.get("peak_bytes_in_use")
+
+
+def counted_flops_per_token(n_params: int, cfg: TransformerConfig,
+                            seq_len: int) -> float:
+    """The standard counted-FLOPs estimate per trained token: ~6N for the
+    fwd+bwd matmuls plus the 12*L*d attention term per layer — the one
+    formula every MFU figure in bench.py and the sweep shares."""
+    return 6.0 * n_params + 12.0 * cfg.n_layers * seq_len * cfg.d_model
+
+
+@dataclasses.dataclass
+class FrontierPoint:
+    """One grid point of :func:`frontier_sweep` — OOM'd points survive in
+    the table (``error`` set, throughput fields None) because an OOM *is*
+    frontier evidence: it pins the HBM envelope at this scale."""
+
+    batch: int
+    seq: int
+    remat: str
+    tokens_per_s: Optional[float] = None
+    achieved_tflops: Optional[float] = None
+    mfu: Optional[float] = None
+    hbm_high_water_gb: Optional[float] = None
+    error: Optional[str] = None
+
+    def record(self) -> Dict[str, Any]:
+        """JSON-able digest (None fields dropped) for the bench telemetry
+        and the docs/PERF.md sweep table."""
+        out: Dict[str, Any] = {
+            "B": self.batch, "L": self.seq, "remat": self.remat,
+        }
+        if self.tokens_per_s is not None:
+            out["tokens_per_s"] = round(self.tokens_per_s, 0)
+            out["achieved_tflops"] = round(self.achieved_tflops, 2)
+        if self.mfu is not None:
+            out["mfu"] = round(self.mfu, 4)
+        if self.hbm_high_water_gb is not None:
+            out["hbm_gb"] = self.hbm_high_water_gb
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+
+def best_frontier_point(
+    points: Sequence[FrontierPoint],
+) -> Optional[FrontierPoint]:
+    """The measured point with the highest MFU (tokens/s tiebreak when no
+    peak-FLOPs table covers the chip), or None if every point errored."""
+    ok = [p for p in points if p.tokens_per_s is not None]
+    if not ok:
+        return None
+    return max(ok, key=lambda p: (p.mfu or 0.0, p.tokens_per_s))
+
+
+def frontier_sweep(
+    cfg: TransformerConfig,
+    tcfg: Optional[TrainConfig] = None,
+    *,
+    batches: Sequence[int] = (8, 16, 32),
+    seqs: Sequence[int] = (1024, 2048, 4096),
+    remat_policies: Sequence[str] = ("selective", "attn", "full"),
+    steps: int = 3,
+    peak_flops: Optional[float] = None,
+    rng: int = 0,
+    log: Optional[Callable[[Dict[str, Any]], None]] = None,
+) -> List[FrontierPoint]:
+    """Measure the train-step MFU frontier over batch x seq x remat.
+
+    Each grid point compiles and times the full ``make_train_step`` step
+    (best of ``steps`` synced reps) at that shape, records tokens/s,
+    counted MFU (:func:`counted_flops_per_token` against the chip's bf16
+    peak), and — when the point RAISED the process-lifetime PJRT
+    high-water mark — that new mark (monotone allocator stat: echoing the
+    running max on smaller later points would misreport their footprint,
+    so only the mark-setting points carry ``hbm_gb``); a point that OOMs
+    (or fails to compile) stays in the table with its ``error``.  Points
+    run cheapest-first (ascending B*L token count) so the mark-setting
+    rows trace the envelope: the first error row pins it at this
+    scale.  ``jax.clear_caches()`` runs between points so one point's
+    executables do not count against the next.
+
+    Returns every :class:`FrontierPoint`; ``bench.py`` adopts
+    :func:`best_frontier_point` as the config-7 flagship when the sweep
+    is enabled (``TFS_MFU_SWEEP=1``) and folds the table into the parsed
+    record.  ``log`` (when given) receives each point's ``record()`` as
+    it finishes — sweeps are long, partial progress must not be lost."""
+    if tcfg is None:
+        tcfg = TrainConfig(learning_rate=3e-4)
+    if peak_flops is None:
+        from .roofline import PEAK_FLOPS
+
+        kind = getattr(jax.devices()[0], "device_kind", "unknown")
+        peak_flops = PEAK_FLOPS.get(kind)
+    rs = np.random.RandomState(rng)
+
+    def run_point(pt: FrontierPoint) -> None:
+        # own frame: on an OOM/compile failure the params/opt_state
+        # buffers die with this frame when the caller's except clause
+        # drops the traceback — an inline try would keep them bound as
+        # sweep locals, squatting HBM under every later point
+        c = dataclasses.replace(
+            cfg, max_seq=pt.seq, remat_policy=pt.remat
+        )
+        toks = jnp.asarray(
+            rs.randint(0, c.vocab_size, (pt.batch, pt.seq)), jnp.int32
+        )
+        tgts = jnp.roll(toks, -1, axis=1)
+        params = tfm.init(jax.random.PRNGKey(rng), c)
+        step, tx = make_train_step(c, tcfg)
+        opt_state = tx.init(params)
+        n_params = sum(
+            int(np.prod(a.shape))
+            for a in jax.tree_util.tree_leaves(params)
+        )
+        p, o, loss = step(params, opt_state, toks, tgts)
+        jax.block_until_ready(loss)  # compile + warm
+        best = float("inf")
+        for _ in range(max(1, steps)):
+            t0 = time.perf_counter()
+            p, o, loss = step(p, o, toks, tgts)
+            jax.block_until_ready((loss, p))
+            best = min(best, time.perf_counter() - t0)
+        pt.tokens_per_s = pt.batch * pt.seq / best
+        fpt = counted_flops_per_token(n_params, c, pt.seq)
+        pt.achieved_tflops = pt.tokens_per_s * fpt / 1e12
+        if peak_flops:
+            pt.mfu = pt.tokens_per_s * fpt / peak_flops
+
+    points: List[FrontierPoint] = []
+    # the PJRT high-water mark is process-lifetime monotone, so a point's
+    # reading is only ITS footprint when it raised the mark; later smaller
+    # points would just echo the running max, which misreports the
+    # envelope — record the mark only on the points that set it
+    prev_hw = hbm_high_water() or 0
+    # cheapest-first must hold ACROSS shapes, not just within an L group
+    # (B=32/L=1024 is costlier than B=8/L=2048): order by token count so
+    # an error row really does pin the envelope and the monotone HBM mark
+    # lands on the points that earn it
+    shapes = sorted(
+        ((B, L) for L in seqs for B in batches), key=lambda s: s[0] * s[1]
+    )
+    for remat in remat_policies:
+        for B, L in shapes:
+            pt = FrontierPoint(batch=B, seq=L, remat=remat)
+            points.append(pt)
+            try:
+                run_point(pt)
+            except Exception as e:  # OOM / compile failure: keep going
+                pt.error = repr(e)[:200]
+            hw = hbm_high_water()
+            if hw is not None and hw > prev_hw:
+                pt.hbm_high_water_gb = round(hw / 2**30, 2)
+                prev_hw = hw
+            if log is not None:
+                log(pt.record())
+            gc.collect()
+            jax.clear_caches()
+    return points

@@ -1048,3 +1048,70 @@ class TestFunctionConds:
         with pytest.raises(UnsupportedOpError, match="data-dependent"):
             jax.jit(lambda x, pr: p.call({"x": x, "p": pr}))(
                 np.arange(3.0), np.bool_(True))
+
+    def test_non_scalar_predicate_names_the_node(self):
+        """A vector-valued constant predicate must raise GraphImportError
+        naming the If node, not numpy's opaque truth-value-ambiguous
+        ValueError (round-6 regression, ADVICE r5)."""
+        from tensorframes_tpu.graphdef.proto import (
+            AttrValue, GraphDef, NodeDef,
+        )
+
+        g = self._if_graph(True)
+        nodes = [n for n in g.nodes if n.name != "p"]
+        nodes.insert(1, NodeDef("p", "Const", [], {
+            "value": AttrValue(
+                "tensor",
+                TensorProto.from_numpy(np.array([True, False]))),
+            "dtype": AttrValue("type", 10),
+        }))
+        g2 = GraphDef(nodes, g.functions)
+        with pytest.raises(GraphImportError, match="cond.*shape \\(2,\\)"):
+            p = import_graphdef(g2, fetches=["out"])
+            p.call({"x": np.arange(3.0)})
+
+    def test_complete_for_tf_preserves_functions(self):
+        """``complete_for_tf`` must carry the FunctionDefLibrary through —
+        dropping it leaves StatelessIf/If with dangling function refs that
+        real TF rejects (round-6 regression, ADVICE r5 medium)."""
+        from tensorframes_tpu.graphdef.tfcompat import complete_for_tf
+
+        g = self._if_graph(True)
+        done = complete_for_tf(g)
+        assert sorted(done.functions) == ["eb", "tb"]
+        assert done.functions["tb"].ret == {"r": "add:z:0"}
+        # the library dict is a copy, not shared mutable state
+        done.functions["extra"] = done.functions["tb"]
+        assert "extra" not in g.functions
+        # the attr-completed graph still encodes with its library and the
+        # re-parsed bytes still import and execute the then-branch
+        g2 = parse_graphdef(done.encode())
+        assert sorted(g2.functions) == ["eb", "tb"]
+        p = import_graphdef(g2, fetches=["out"])
+        np.testing.assert_allclose(
+            np.asarray(p.call({"x": np.arange(3.0)})["out"]),
+            np.arange(3.0) + 1.0)
+
+
+# ------------------------------------------------- tfcompat attr filling --
+
+
+def test_complete_for_tf_out_of_range_output_leaves_attr_unset():
+    """A consumer referencing an output index beyond what the producer's
+    attrs define (e.g. Unpack missing ``num``) must NOT get a guessed
+    dtype attr stamped from output 0 — best-effort means leaving the attr
+    for TF's own importer to reject or default (round-6 regression)."""
+    from tensorframes_tpu.graphdef.proto import AttrValue, NodeDef
+    from tensorframes_tpu.graphdef.tfcompat import complete_for_tf
+
+    nodes = [
+        NodeDef("x", "Placeholder", [], {"dtype": AttrValue("type", 2)}),
+        # no ``num`` attr: the pass cannot know Unpack's output arity and
+        # assumes 1 output
+        NodeDef("u", "Unpack", ["x"], {}),
+        NodeDef("keep", "Identity", ["u:0"], {}),
+        NodeDef("oob", "Identity", ["u:2"], {}),
+    ]
+    done = complete_for_tf(GraphDef(nodes)).node_map()
+    assert done["keep"].attrs["T"].value == 2
+    assert "T" not in done["oob"].attrs

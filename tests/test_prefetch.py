@@ -1,0 +1,316 @@
+"""Async double-buffered block prefetch + donation (round 6 tentpole).
+
+Correctness contract: the prefetched/donated paths must be BIT-IDENTICAL
+to the synchronous path (TFS_PREFETCH_BLOCKS=0, no donation) for
+map_blocks, the streamed chunk path, and a fused pipeline.run — the
+overlap machinery may only change *when* work happens, never results.
+Donation is forced on (TFS_DONATE=1) so the donating executables are the
+ones exercised even on the CPU test backend (where jax warns that the
+donation is unusable and copies — the code path is identical, the reuse
+is not, which is exactly what CI can check without a TPU)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.ops import prefetch
+from tensorframes_tpu.ops.engine import Executor
+from tensorframes_tpu.ops.pipeline import pipeline
+
+
+@pytest.fixture(autouse=True)
+def _quiet_cpu_donation_warning():
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        yield
+
+
+def _frame(arr, blocks=4):
+    return tfs.analyze(
+        tfs.TensorFrame.from_arrays({"x": arr}, num_blocks=blocks)
+    )
+
+
+# -- Prefetcher unit behavior ------------------------------------------------
+
+
+def test_prefetcher_yields_in_order_and_records_stats():
+    pf = prefetch.Prefetcher(lambda i: i * i, 10, depth=3)
+    assert list(pf) == [i * i for i in range(10)]
+    assert pf.stats["items"] == 10
+    assert pf.stats["stage_s"] >= 0.0
+    assert 0.0 <= pf.overlap_ratio() <= 1.0
+
+
+def test_prefetcher_depth_zero_is_synchronous():
+    order = []
+
+    def stage(i):
+        order.append(i)
+        return i
+
+    pf = prefetch.Prefetcher(stage, 5, depth=0)
+    got = []
+    for v in pf:
+        got.append(v)
+        # synchronous: nothing staged beyond what was consumed
+        assert order == list(range(len(got)))
+    assert got == list(range(5))
+
+
+def test_prefetcher_stages_ahead_of_consumer():
+    import threading
+
+    gate = threading.Event()
+    staged = []
+
+    def stage(i):
+        staged.append(i)
+        if i == 2:
+            gate.set()  # depth-2 window filled while item 0 is held
+        return i
+
+    pf = prefetch.Prefetcher(stage, 6, depth=2)
+    it = iter(pf)
+    assert next(it) == 0
+    assert gate.wait(timeout=5.0), "worker never ran ahead of the consumer"
+    assert list(it) == [1, 2, 3, 4, 5]
+
+
+def test_prefetcher_propagates_stage_errors_in_order():
+    def stage(i):
+        if i == 3:
+            raise RuntimeError("boom at 3")
+        return i
+
+    pf = prefetch.Prefetcher(stage, 6, depth=2)
+    got = []
+    with pytest.raises(RuntimeError, match="boom at 3"):
+        for v in pf:
+            got.append(v)
+    assert got == [0, 1, 2]
+
+
+def test_prefetcher_consumer_break_reaps_worker():
+    import threading
+
+    before = threading.active_count()
+    pf = prefetch.Prefetcher(lambda i: i, 100, depth=2)
+    for v in pf:
+        if v == 1:
+            break
+    # the staging thread must not leak after an early consumer exit
+    assert threading.active_count() <= before + 1
+
+
+def test_stage_columns_moves_host_passes_device():
+    dev = jax.device_put(jnp.arange(4.0))
+    out = prefetch.stage_columns({"h": np.arange(3.0), "d": dev})
+    assert isinstance(out["h"], jax.Array)
+    assert out["d"] is dev
+
+
+# -- engine: map_blocks / map_rows parity under donation ---------------------
+
+
+def _sync_env(monkeypatch):
+    monkeypatch.setenv("TFS_PREFETCH_BLOCKS", "0")
+    monkeypatch.setenv("TFS_DONATE", "0")
+
+
+def _overlap_env(monkeypatch):
+    monkeypatch.setenv("TFS_PREFETCH_BLOCKS", "2")
+    monkeypatch.setenv("TFS_DONATE", "1")
+
+
+def test_map_blocks_prefetched_bit_identical(monkeypatch):
+    x = np.random.RandomState(0).rand(4096, 16)
+    fn = lambda x: {"z": jnp.tanh(x) * 3.0 + x.sum()}  # noqa: E731
+    _sync_env(monkeypatch)
+    ref = np.asarray(tfs.map_blocks(fn, _frame(x)).column("z").data)
+    _overlap_env(monkeypatch)
+    got = np.asarray(tfs.map_blocks(fn, _frame(x)).column("z").data)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_map_rows_prefetched_bit_identical(monkeypatch):
+    x = np.random.RandomState(1).rand(2048, 8)
+    fn = lambda x: {"n": (x * x).sum()}  # noqa: E731
+    _sync_env(monkeypatch)
+    ref = np.asarray(tfs.map_rows(fn, _frame(x)).column("n").data)
+    _overlap_env(monkeypatch)
+    got = np.asarray(tfs.map_rows(fn, _frame(x)).column("n").data)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_streamed_chunk_path_bit_identical_under_donation(monkeypatch):
+    x = np.random.RandomState(2).rand(4096, 8)
+    fn = lambda x: {"z": jnp.sqrt(x) + 1.0}  # noqa: E731
+    _sync_env(monkeypatch)
+    ref = np.asarray(tfs.map_blocks(fn, _frame(x, blocks=1)).column("z").data)
+    _overlap_env(monkeypatch)
+    monkeypatch.setattr(Executor, "stream_chunk_bytes", 8 * 1024)
+    got = np.asarray(tfs.map_blocks(fn, _frame(x, blocks=1)).column("z").data)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_donated_path_used_for_host_blocks(monkeypatch):
+    """The donating executable really is the one dispatched for freshly
+    staged host blocks (keyed separately in the Program's derived cache)."""
+    _overlap_env(monkeypatch)
+    x = np.random.RandomState(3).rand(256, 4)
+    program = tfs.Program.wrap(lambda x: {"z": x + 1.0}, fetches=["z"])
+    tfs.map_blocks(program, _frame(x))
+    assert ("map_blocks", "donated") in program._derived
+
+
+def test_cached_frame_not_donated_and_survives(monkeypatch):
+    """Device-resident (cached) columns are shared state: the donated
+    entry must NOT be used, and the cached buffers stay valid after."""
+    _overlap_env(monkeypatch)
+    x = np.random.RandomState(4).rand(512, 4)
+    f = _frame(x).cache()
+    program = tfs.Program.wrap(lambda x: {"z": x * 2.0}, fetches=["z"])
+    out = tfs.map_blocks(program, f)
+    assert ("map_blocks", "donated") not in program._derived
+    # the cached column is still readable (no use-after-donate)
+    np.testing.assert_allclose(np.asarray(f.column("x").data), x)
+    np.testing.assert_allclose(np.asarray(out.column("z").data), x * 2.0)
+
+
+def test_host_stage_runs_on_staging_thread_results_identical(monkeypatch):
+    import threading
+
+    threads = set()
+
+    def decode(cells):
+        threads.add(threading.current_thread().name)
+        return np.stack([np.frombuffer(c, dtype=np.float32) for c in cells])
+
+    payloads = [
+        np.arange(4, dtype=np.float32).tobytes() for _ in range(64)
+    ]
+    frame = tfs.TensorFrame.from_arrays({"raw": payloads}, num_blocks=4)
+    _overlap_env(monkeypatch)
+    out = tfs.map_blocks(
+        lambda raw: {"s": raw.sum(1)}, frame, host_stage={"raw": decode}
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.column("s").data), np.full(64, 6.0)
+    )
+    assert any(t.startswith("tfs-prefetch") for t in threads)
+
+
+def test_prefetch_stats_on_span(monkeypatch):
+    from tensorframes_tpu import observability
+
+    _overlap_env(monkeypatch)
+    x = np.random.RandomState(5).rand(1024, 8)
+    observability.enable()
+    try:
+        tfs.map_blocks(lambda x: {"z": x + 1}, _frame(x))
+    finally:
+        observability.disable()
+    span = observability.last_spans(1)[0]
+    assert span["verb"] == "map_blocks"
+    pf = span["prefetch"]
+    assert pf["items"] == 4 and pf["donate"] is True
+    assert 0.0 <= pf["overlap_ratio"] <= 1.0
+
+
+# -- fused pipeline parity under donation ------------------------------------
+
+
+def test_pipeline_run_bit_identical_under_donation(monkeypatch):
+    x = np.random.RandomState(6).rand(1024, 8)
+    y = np.random.RandomState(7).rand(1024)
+
+    def build():
+        frame = tfs.analyze(
+            tfs.TensorFrame.from_arrays({"x": x, "y": y}, num_blocks=4)
+        )
+        return (
+            pipeline(frame)
+            .map_blocks(lambda x, y: {"s": x.sum(1) * y})
+            .reduce_blocks(lambda s_input: {"s": s_input.sum(0)})
+        )
+
+    _sync_env(monkeypatch)
+    ref = build().collect()
+    _overlap_env(monkeypatch)
+    got = build().collect()
+    np.testing.assert_array_equal(got["s"], ref["s"])
+
+
+def test_pipeline_map_terminal_bit_identical_under_donation(monkeypatch):
+    x = np.random.RandomState(8).rand(512, 8)
+
+    def build():
+        frame = tfs.analyze(
+            tfs.TensorFrame.from_arrays({"x": x}, num_blocks=2)
+        )
+        return pipeline(frame).map_rows(lambda x: {"n": (x * x).sum()})
+
+    _sync_env(monkeypatch)
+    ref = np.asarray(build().run().column("n").data)
+    _overlap_env(monkeypatch)
+    got = np.asarray(build().run().column("n").data)
+    np.testing.assert_array_equal(got, ref)
+    # passthrough source column also survives in the donated output frame
+    out = build().run()
+    np.testing.assert_array_equal(np.asarray(out.column("x").data), x)
+
+
+def test_pipeline_cached_frame_never_donates(monkeypatch):
+    _overlap_env(monkeypatch)
+    x = np.random.RandomState(9).rand(256, 4)
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"x": x}, num_blocks=2)
+    ).cache()
+    pipe = pipeline(frame).reduce_blocks(
+        lambda x_input: {"x": x_input.sum(0)}
+    )
+    pipe.run()
+    assert list(pipe._compiled) == [False]
+    # cached columns still valid after repeated runs
+    pipe.run()
+    np.testing.assert_allclose(np.asarray(frame.column("x").data), x)
+
+
+def test_pipeline_iterate_parity_under_donation(monkeypatch):
+    x = np.random.RandomState(10).rand(512, 4).astype(np.float32)
+
+    def build():
+        frame = tfs.analyze(
+            tfs.TensorFrame.from_arrays({"x": x}, num_blocks=2)
+        )
+        prog = tfs.Program.wrap(
+            lambda x, w: {"g": (x * w).sum(0)},
+            fetches=["g"],
+            params={"w": np.ones(4, np.float32)},
+        )
+        return (
+            pipeline(frame)
+            .map_blocks(prog, trim=True)
+            .reduce_blocks(lambda g_input: {"g": g_input.sum(0)})
+            .then(lambda row, params: {
+                "g": row["g"], "w": params["w"] - 0.01 * row["g"],
+            })
+        )
+
+    _sync_env(monkeypatch)
+    ref_finals, ref_hist = build().iterate(5, carry={"w": "w"}, collect=("g",))
+    _overlap_env(monkeypatch)
+    got_finals, got_hist = build().iterate(5, carry={"w": "w"}, collect=("g",))
+    np.testing.assert_array_equal(
+        np.asarray(got_finals["w"]), np.asarray(ref_finals["w"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_hist["g"]), np.asarray(ref_hist["g"])
+    )

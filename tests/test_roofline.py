@@ -1,0 +1,147 @@
+"""Roofline analysis (round 6: ceiling_mfu for the bench telemetry)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorframes_tpu import roofline
+
+
+PEAK = dict(peak_flops=100e12, peak_bytes_per_s=800e9)
+
+
+def test_dot_flops_from_real_compiled_hlo():
+    m, k, n = 64, 128, 32
+    f = jax.jit(lambda a, b: a @ b)
+    rep = roofline.roofline(
+        f,
+        jnp.ones((m, k), jnp.float32),
+        jnp.ones((k, n), jnp.float32),
+        device_kind="test",
+        **PEAK,
+    )
+    dots = [o for o in rep.ops if o.kind == "dot"]
+    if rep.source == "hlo":
+        assert len(dots) == 1
+        assert dots[0].flops == 2 * m * k * n
+    else:  # backend lowered the dot away from plain HLO: aggregate fallback
+        assert rep.total_flops > 0
+    assert 0.0 < rep.ceiling_mfu <= 1.0
+
+
+def test_conv_flops_from_real_compiled_hlo():
+    x = jnp.ones((2, 16, 16, 8), jnp.float32)
+    w = jnp.ones((3, 3, 8, 16), jnp.float32)
+
+    def f(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        )
+
+    rep = roofline.roofline(f, x, w, device_kind="test", **PEAK)
+    convs = [o for o in rep.ops if o.kind == "convolution"]
+    if convs:
+        # dense MAC upper bound: 2 * out_elems * kh*kw*cin
+        assert convs[0].flops == 2 * (2 * 16 * 16 * 16) * (3 * 3 * 8)
+    assert rep.ceiling_tflops > 0
+
+
+def test_parser_on_canned_hlo_fusion_inherits_dot_flops():
+    hlo = """HloModule m, is_scheduled=true
+
+%fused_computation.1 (p0: f32[8,16], p1: f32[16,4]) -> f32[8,4] {
+  %p0 = f32[8,16]{1,0} parameter(0)
+  %p1 = f32[16,4]{1,0} parameter(1)
+  %dot.1 = f32[8,4]{1,0} dot(f32[8,16]{1,0} %p0, f32[16,4]{1,0} %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %exp.1 = f32[8,4]{1,0} exponential(f32[8,4]{1,0} %dot.1)
+}
+
+ENTRY %main (a: f32[8,16], b: f32[16,4]) -> f32[8,4] {
+  %a = f32[8,16]{1,0} parameter(0)
+  %b = f32[16,4]{1,0} parameter(1)
+  ROOT %fusion.1 = f32[8,4]{1,0} fusion(f32[8,16]{1,0} %a, f32[16,4]{1,0} %b), kind=kOutput, calls=%fused_computation.1
+}
+"""
+    ops = roofline._parse_ops(hlo)
+    assert len(ops) == 1
+    name, kind, flops, nbytes = ops[0]
+    assert kind == "fusion"
+    assert flops == 2 * 8 * 16 * 4
+    # bytes: two operands + output, f32
+    assert nbytes == 4 * (8 * 16 + 16 * 4 + 8 * 4)
+
+
+def test_parser_skips_parameters_and_tolerates_unknown_ops():
+    hlo = """HloModule m
+
+ENTRY %main (a: f32[64]) -> f32[64] {
+  %a = f32[64]{0} parameter(0)
+  %weird.1 = f32[64]{0} some-future-op(f32[64]{0} %a), attr={x=1}
+  ROOT %t.2 = f32[64]{0} tanh(f32[64]{0} %weird.1)
+}
+"""
+    ops = roofline._parse_ops(hlo)
+    kinds = {k for _, k, _, _ in ops}
+    assert "parameter" not in kinds
+    assert {"some-future-op", "tanh"} <= kinds
+    # unknown ops contribute bytes (bandwidth term) even with zero flops
+    assert all(b > 0 for _, _, _, b in ops)
+
+
+def test_ceiling_mfu_low_for_bandwidth_bound_mix():
+    """An elementwise-only executable must report a ceiling far below 1:
+    the roofline says this op mix can never reach peak FLOP/s."""
+    f = jax.jit(lambda a: a + 1.0)
+    rep = roofline.roofline(
+        f, jnp.ones((1 << 16,), jnp.float32), device_kind="test", **PEAK
+    )
+    assert rep.ceiling_mfu < 0.05
+
+
+def test_compute_bound_dot_ceiling_near_one():
+    f = jax.jit(lambda a, b: a @ b)
+    rep = roofline.roofline(
+        f,
+        jnp.ones((1024, 1024), jnp.float32),
+        jnp.ones((1024, 1024), jnp.float32),
+        device_kind="test",
+        **PEAK,
+    )
+    if rep.source == "hlo":
+        assert rep.ceiling_mfu > 0.5
+
+
+def test_measured_side_and_summary_json():
+    f = jax.jit(lambda a, b: a @ b)
+    rep = roofline.roofline(
+        f,
+        jnp.ones((256, 256), jnp.float32),
+        jnp.ones((256, 256), jnp.float32),
+        measured_s=1e-3,
+        device_kind="test",
+        **PEAK,
+    )
+    assert rep.mfu is not None and rep.mfu > 0
+    assert rep.ceiling_fraction == pytest.approx(
+        rep.mfu / rep.ceiling_mfu, rel=1e-6
+    )
+    s = rep.summary(top=3)
+    json.dumps(s)  # JSON-able for the bench record
+    assert s["ceiling_mfu"] == round(rep.ceiling_mfu, 4)
+    assert s["top_ops"] and "intensity" in s["top_ops"][0]
+
+
+def test_unknown_device_without_peaks_raises():
+    f = jax.jit(lambda a: a * 2)
+    with pytest.raises(ValueError, match="no peak specs"):
+        roofline.roofline(f, jnp.ones((4,), jnp.float32),
+                          device_kind="made-up chip")
+
+
+def test_peak_tables_cover_the_bench_chips():
+    for kind in ("TPU v4", "TPU v5 lite", "TPU v5e", "TPU v5p", "TPU v6e"):
+        assert kind in roofline.PEAK_FLOPS
+        assert kind in roofline.PEAK_BYTES_PER_S

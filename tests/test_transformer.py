@@ -15,7 +15,6 @@ import numpy as np
 import pytest
 from jax.sharding import AxisType, NamedSharding, PartitionSpec as P
 
-from _isolate import isolated
 from tensorframes_tpu import train
 from tensorframes_tpu.checkpoint import Checkpointer
 from tensorframes_tpu.models import transformer as tfm
@@ -685,22 +684,22 @@ def test_1f1b_validation_errors(setup):
             )
 
 
-@isolated
 def test_1f1b_composes_with_gspmd_sp(setup):
     """1F1B + an sp axis under FULL attention: the sequence shards via
     GSPMD (auto axes) inside the stage bodies — only the sp-MANUAL ring
     kernels are excluded from this schedule.
 
-    Process-isolated (``_isolate.isolated``): this composition trips an
-    XLA:CPU collective-permute rendezvous race whose firing rate is
-    load- and shape-dependent (r4: SIGABRT only after ~500 prior GSPMD
-    tests; r5: measured 15-50% standalone at L=16 and ~20% at L=32 under
-    concurrent load, 0% on a quiet box) — an upstream runtime fragility,
-    documented in ``tests/_isolate.py``.  The test therefore (a) runs in
-    its own interpreter with native-death-only retries (assertion
-    failures still fail fast) and (b) uses L=32 tokens (larger
-    per-device sp chunks narrow the race window; the parity property
-    checked is identical)."""
+    Process-isolated AUTOMATICALLY (conftest ``gspmd_isolated`` marker —
+    this source mentions the 1f1b/collective surface, which is the whole
+    detection rule): the composition trips an XLA:CPU collective-permute
+    rendezvous race whose firing rate is load- and shape-dependent (r4:
+    SIGABRT only after ~500 prior GSPMD tests; r5: measured 15-50%
+    standalone at L=16 and ~20% at L=32 under concurrent load, 0% on a
+    quiet box) — an upstream runtime fragility, documented in
+    ``tests/conftest.py``.  The test therefore (a) runs in its own
+    interpreter with native-death-only retries (assertion failures still
+    fail fast) and (b) uses L=32 tokens (larger per-device sp chunks
+    narrow the race window; the parity property checked is identical)."""
     cfg, params, _, _ = setup
     toks = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 97)
     tgts = jnp.roll(toks, -1, axis=1)

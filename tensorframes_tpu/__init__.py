@@ -18,7 +18,7 @@ The user-facing module mirrors the reference's python API
     s = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, tf)
 """
 
-from . import dsl, observability, resilience
+from . import compile_cache, dsl, observability, resilience
 from .analyze import analyze, explain, print_schema
 from .builder import OpBuilder
 from .observability import initialize_logging
@@ -37,6 +37,7 @@ from .ops import (
     pipeline,
     reduce_blocks,
     reduce_rows,
+    warmup,
 )
 from .program import (
     GraphNodeSummary,
@@ -49,6 +50,15 @@ from .shape import Shape, ShapeError, UNKNOWN
 
 __version__ = "0.1.0"
 
+# retrace/compile accounting (jax.monitoring listeners) is always on —
+# it is two dict increments per compile and the observability counters
+# are the evidence layer for compile-count claims (bench, tests)
+observability.install_counters()
+# persistent executable cache: honored at import when TFS_COMPILE_CACHE
+# is set, so every entry point (verbs, pipelines, bench, serving) shares
+# one cross-process compile cache
+compile_cache.configure()
+
 
 def map_blocks_trimmed(fn, frame, **kw):
     """``tfs.map_blocks(..., trim=True)`` — output row count may differ from
@@ -57,9 +67,11 @@ def map_blocks_trimmed(fn, frame, **kw):
 
 
 __all__ = [
+    "compile_cache",
     "dsl",
     "block",
     "row",
+    "warmup",
     "OpBuilder",
     "observability",
     "initialize_logging",

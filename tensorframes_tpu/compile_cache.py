@@ -1,0 +1,101 @@
+"""Persistent XLA executable cache wiring (``TFS_COMPILE_CACHE``).
+
+The in-process jit cache (``Program.jitted`` and friends) amortizes
+compiles within one process, and shape-canonical bucketing
+(``ops/bucketing.py``) keeps the signature count O(log shape) — but
+nothing survived the process: every cold start of a serving replica or a
+bench run paid full XLA compile for every program (docs/PERF.md's
+1.4-18 rows/s cold-start numbers).  jax ships a content-addressed
+persistent compilation cache keyed by (HLO, compile options, backend);
+this module is the one place it gets wired:
+
+* ``configure(path=None)`` — point jax's compilation cache at ``path``
+  (default: the ``TFS_COMPILE_CACHE`` env var; no-op when neither is
+  set).  The min-compile-time floor is dropped to 0 so the small block
+  programs the verbs build are persisted too, not just multi-second
+  model compiles.  Idempotent; called automatically at package import
+  when ``TFS_COMPILE_CACHE`` is set, so every entry point honors the
+  knob.
+* hit/miss accounting rides :mod:`tensorframes_tpu.observability`'s
+  jax-monitoring listeners (``counters()["persistent_cache_hits"]``),
+  which is how the bench proves a second process skipped XLA instead of
+  asserting it.
+
+With the cache configured, ``Program.aot_compile`` (the
+``lower().compile()`` path) in a fresh process deserializes the
+executable from disk — compile cost per (program, bucket signature)
+becomes O(1) across process restarts, not per run.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_VAR = "TFS_COMPILE_CACHE"
+
+_configured_dir: Optional[str] = None
+
+
+def configure(path: Optional[str] = None) -> bool:
+    """Enable jax's persistent compilation cache at ``path`` (or
+    ``$TFS_COMPILE_CACHE``).  Returns True when a cache is active.
+
+    Safe to call repeatedly; re-pointing at a new path reconfigures."""
+    global _configured_dir
+    path = path or os.environ.get(ENV_VAR) or None
+    if not path:
+        return _configured_dir is not None
+    path = os.path.abspath(path)
+    if _configured_dir == path:
+        return True
+    import jax
+
+    from . import observability
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # default floor (1s) would skip every small verb program — the exact
+    # executables whose per-restart recompiles this cache exists to kill
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # flag absent on this jax: keep its default
+    # jax latches cache-enabled-ness at the FIRST compile of the process
+    # (compilation_cache.is_cache_used's one-shot check): if anything
+    # compiled before configure(), the latch reads "disabled" forever.
+    # reset_cache() clears the latch (and the in-memory cache object) so
+    # a mid-process configure takes effect.
+    _reset_jax_cache()
+    observability.install_counters()
+    _configured_dir = path
+    return True
+
+
+def _reset_jax_cache() -> None:
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+
+        _cc.reset_cache()
+    except Exception:
+        pass  # older jax: no latch to clear
+
+
+def cache_dir() -> Optional[str]:
+    """The active persistent cache directory, or None."""
+    return _configured_dir
+
+
+def deconfigure() -> None:
+    """Turn the persistent cache back off (tests)."""
+    global _configured_dir
+    if _configured_dir is None:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    _reset_jax_cache()
+    _configured_dir = None

@@ -383,11 +383,23 @@ class TensorFrame:
 
     def repartition(self, num_blocks: int) -> "TensorFrame":
         """Rebalance into ``num_blocks`` near-equal blocks (Spark
-        ``repartition`` analog; used to map blocks onto mesh slots)."""
+        ``repartition`` analog; used to map blocks onto mesh slots).
+
+        The block count is capped at the row count (no empty blocks are
+        dealt).  Empty-frame contract: a 0-row frame always has exactly
+        ONE empty block, whatever ``num_blocks`` says; the verbs then
+        give it defined semantics — the non-trimmed map verbs return an
+        empty frame with the program's inferred output schema (no
+        compile), a trimmed map applies the program to the empty block,
+        ``reduce_rows``/``reduce_blocks`` raise (no identity element for
+        an arbitrary program), and ``aggregate`` returns an empty result
+        frame (zero groups)."""
         n = self.num_rows
         if num_blocks < 1:
             raise SchemaError(f"num_blocks must be >= 1, got {num_blocks}")
-        num_blocks = min(num_blocks, n) or 1
+        if n == 0:
+            return TensorFrame(list(self._columns), (0, 0))
+        num_blocks = min(num_blocks, n)
         base, extra = divmod(n, num_blocks)
         offsets = [0]
         for i in range(num_blocks):

@@ -64,14 +64,34 @@ def pil_decoder(channels: int = 0, op: str = "DecodeJpeg"):
             if a.ndim == 2:  # "L" gives [H, W]; TF emits [H, W, 1]
                 a = a[..., None]
             arrs.append(a)
-        sizes = {a.shape for a in arrs}
-        if len(sizes) > 1:
+        by_size = {}
+        for i, a in enumerate(arrs):
+            by_size.setdefault(a.shape, []).append(i)
+        if len(by_size) > 1:
+            # name the offending ROWS, not just the size set: the fix is
+            # grouping/resizing specific rows, so point at them (indices
+            # are relative to this device call's block / shape bucket)
+            majority = max(by_size.items(), key=lambda kv: len(kv[1]))[0]
+            offenders = "; ".join(
+                f"rows {_fmt_rows(idxs)} decoded to {shape}"
+                for shape, idxs in sorted(by_size.items())
+                if shape != majority
+            )
             raise ValueError(
-                f"{op} host decode produced mixed image sizes {sorted(sizes)} "
-                f"within one device call; images must be uniform per block "
-                f"(map_blocks) or per shape bucket (map_rows) — group rows "
-                f"by size or pre-resize in a custom host_stage"
+                f"{op} host decode produced mixed image sizes within one "
+                f"device call: majority size is {majority}, but {offenders} "
+                f"(row indices within this block/bucket); images must be "
+                f"uniform per block (map_blocks) or per shape bucket "
+                f"(map_rows) — group rows by size or pre-resize in a "
+                f"custom host_stage"
             )
         return np.stack(arrs)
 
     return decode
+
+
+def _fmt_rows(idxs, cap: int = 8) -> str:
+    """``[0, 3, 7]`` -> ``"0, 3, 7"``, long lists elided with a count."""
+    shown = ", ".join(str(i) for i in idxs[:cap])
+    extra = len(idxs) - cap
+    return f"{shown}, … (+{extra} more)" if extra > 0 else shown

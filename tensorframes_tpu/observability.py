@@ -16,13 +16,23 @@ The reference's observability is a Logging trait + log4j config + pervasive
   in TensorBoard/XProf — the real tool for on-device timeline analysis;
 * ``last_spans()`` — the most recent spans as dicts (programmatic access;
   what ``bench.py`` surfaces as its phase breakdown).
+* **retrace counters** (round 7) — always-on cumulative counts of
+  program-function traces (``program_traces``, noted by ``Program.call``
+  per traced application, attributed to the enclosing verb), XLA backend
+  compiles (``backend_compiles``) and persistent-compilation-cache
+  hits/misses, the latter two fed by ``jax.monitoring`` listeners.
+  ``counters()`` snapshots them; enabled spans attach the per-verb delta
+  as ``retrace``; ``bench.py`` attaches the per-config delta to every
+  record — compile counts are *proven*, not asserted.
 
-Deliberately cheap: a disabled span is one ``if``.
+Deliberately cheap: a disabled span is one ``if``; a counter bump is one
+dict increment.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import logging
 import time
 from typing import Any, Dict, List, Optional
@@ -37,6 +47,126 @@ _state: Dict[str, Any] = {
     "profile_dir": None,
     "spans": [],
 }
+
+# -- retrace counters ---------------------------------------------------------
+
+# jax.monitoring event names (stable since jax 0.4.x): one duration event
+# per XLA backend compile; one plain event per persistent-cache hit/miss
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_counters: Dict[str, int] = {
+    "program_traces": 0,
+    "backend_compiles": 0,
+    "persistent_cache_hits": 0,
+    "persistent_cache_misses": 0,
+}
+_by_verb: Dict[str, Dict[str, int]] = {}
+
+# the verb currently executing on this thread (set by verb_span even when
+# spans are disabled, so counter attribution never depends on enable())
+_current_verb: "contextvars.ContextVar[Optional[str]]" = (
+    contextvars.ContextVar("tfs_current_verb", default=None)
+)
+# analysis-only traces (eval_shape in Program.analyze, the segment
+# compiler's jaxpr probes, serialization) must not read as retraces
+_suppress_traces: "contextvars.ContextVar[bool]" = contextvars.ContextVar(
+    "tfs_suppress_traces", default=False
+)
+
+_listeners_installed = False
+
+
+def _verb_bump(kind: str) -> None:
+    verb = _current_verb.get()
+    if verb is not None:
+        _by_verb.setdefault(
+            verb, {"program_traces": 0, "backend_compiles": 0}
+        )[kind] += 1
+
+
+def note_program_trace() -> None:
+    """Called by ``Program.call`` per traced application of the user
+    program (jit only invokes the python function on a signature-cache
+    miss, so in steady state this counter does not move)."""
+    if _suppress_traces.get():
+        return
+    _counters["program_traces"] += 1
+    _verb_bump("program_traces")
+
+
+@contextlib.contextmanager
+def suppress_trace_count():
+    """Trace-counter suppression for analysis-time tracing (shape
+    inference, jaxpr probes, export) — those are not retraces."""
+    token = _suppress_traces.set(True)
+    try:
+        yield
+    finally:
+        _suppress_traces.reset(token)
+
+
+def _on_event(name: str, **kw) -> None:
+    if name == _CACHE_HIT_EVENT:
+        _counters["persistent_cache_hits"] += 1
+    elif name == _CACHE_MISS_EVENT:
+        _counters["persistent_cache_misses"] += 1
+
+
+def _on_event_duration(name: str, duration: float, **kw) -> None:
+    if name == _BACKEND_COMPILE_EVENT:
+        _counters["backend_compiles"] += 1
+        _verb_bump("backend_compiles")
+
+
+def install_counters() -> None:
+    """Register the jax.monitoring listeners feeding ``counters()``.
+
+    Idempotent; called at package import (jax is already a hard
+    dependency of the engine by then).  jax offers no per-listener
+    deregistration, so the listeners live for the process — they are two
+    dict increments per compile, nothing on the hot path."""
+    global _listeners_installed
+    if _listeners_installed:
+        return
+    import jax.monitoring
+
+    jax.monitoring.register_event_listener(_on_event)
+    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+    _listeners_installed = True
+
+
+def counters() -> Dict[str, Any]:
+    """Snapshot of the cumulative retrace counters.
+
+    ``program_traces`` counts traced applications of user programs
+    (``Program.call`` invocations under tracing, analysis excluded);
+    ``backend_compiles`` counts XLA compiles process-wide, including the
+    engine's eager glue ops (slices/concats), so it is an upper bound on
+    program compiles; ``by_verb`` attributes both to the verb that was
+    running.  Diff two snapshots (:func:`counters_delta`) to meter one
+    region."""
+    snap: Dict[str, Any] = dict(_counters)
+    snap["by_verb"] = {k: dict(v) for k, v in _by_verb.items()}
+    return snap
+
+
+def counters_delta(
+    before: Dict[str, Any], after: Optional[Dict[str, Any]] = None
+) -> Dict[str, int]:
+    """``after - before`` for the scalar counters (``after`` defaults to
+    a fresh snapshot)."""
+    after = after if after is not None else counters()
+    return {
+        k: after[k] - before.get(k, 0)
+        for k in (
+            "program_traces",
+            "backend_compiles",
+            "persistent_cache_hits",
+            "persistent_cache_misses",
+        )
+    }
 
 
 def initialize_logging(level=logging.INFO, stream=None) -> None:
@@ -79,12 +209,13 @@ def last_spans(n: int = 10) -> List[Dict[str, Any]]:
 class _Span:
     """One verb invocation's phase timings."""
 
-    __slots__ = ("verb", "meta", "phases", "_t0", "_last")
+    __slots__ = ("verb", "meta", "phases", "_t0", "_last", "_counters0")
 
     def __init__(self, verb: str, meta: Dict[str, Any]):
         self.verb = verb
         self.meta = meta
         self.phases: Dict[str, float] = {}
+        self._counters0 = dict(_counters)
         self._t0 = time.perf_counter()
         self._last = self._t0
 
@@ -104,6 +235,7 @@ class _Span:
         rec = {
             "verb": self.verb,
             **self.meta,
+            "retrace": counters_delta(self._counters0),
             "phases_s": {k: round(v, 6) for k, v in self.phases.items()},
             "total_s": round(total, 6),
         }
@@ -138,23 +270,29 @@ _NULL = _NullSpan()
 def verb_span(verb: str, rows: int, blocks: int):
     """Context manager wrapping one verb invocation.
 
-    Yields a span with ``.mark(phase)``; a no-op singleton when disabled."""
-    if not _state["enabled"]:
-        yield _NULL
-        return
-    span = _Span(verb, {"rows": rows, "blocks": blocks})
-    profile_dir = _state["profile_dir"]
+    Yields a span with ``.mark(phase)``; a no-op singleton when disabled.
+    Always tags the thread with the verb name so the retrace counters
+    attribute traces/compiles per verb even with spans disabled."""
+    token = _current_verb.set(verb)
     try:
-        if profile_dir:
-            import jax
+        if not _state["enabled"]:
+            yield _NULL
+            return
+        span = _Span(verb, {"rows": rows, "blocks": blocks})
+        profile_dir = _state["profile_dir"]
+        try:
+            if profile_dir:
+                import jax
 
-            with jax.profiler.trace(profile_dir):
+                with jax.profiler.trace(profile_dir):
+                    yield span
+            else:
                 yield span
-        else:
-            yield span
-    except BaseException:
-        # failed verbs must still record: the span is the diagnostic
-        span.meta["failed"] = True
-        raise
+        except BaseException:
+            # failed verbs must still record: the span is the diagnostic
+            span.meta["failed"] = True
+            raise
+        finally:
+            span._finish()
     finally:
-        span._finish()
+        _current_verb.reset(token)

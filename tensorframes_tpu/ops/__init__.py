@@ -8,6 +8,7 @@ from .engine import (
     map_rows,
     reduce_blocks,
     reduce_rows,
+    warmup,
 )
 from .pipeline import Pipeline, pipeline
 from .validation import ValidationError
@@ -23,4 +24,5 @@ __all__ = [
     "reduce_blocks",
     "reduce_rows",
     "ValidationError",
+    "warmup",
 ]

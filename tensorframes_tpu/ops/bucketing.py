@@ -1,0 +1,151 @@
+"""Shape-canonical execution: geometric row/cell bucket padding.
+
+The engine compiles one XLA executable per *input signature* (shapes +
+dtypes), so every distinct block row count costs a full trace + compile.
+``TensorFrame.repartition`` deals near-equal blocks that differ by one
+row (``frame.py``), so an uneven frame compiles every block program at
+least twice; ragged ``map_rows`` traces once per distinct cell shape —
+"bounded" only if the data cooperates; and a new frame size is a new
+signature even when the program is identical.  Compile cost therefore
+scales O(frames x shapes) when the ROADMAP north-star needs it O(1)
+amortized per program.
+
+This module supplies the canonicalization policy shared by the verbs:
+
+* :func:`bucket_for` rounds a row count (or a ragged cell's lead dim) up
+  to a small geometric bucket set — powers of two by default, overridden
+  with ``TFS_BLOCK_BUCKETS`` (comma-separated ladder; counts above the
+  ladder round up to a multiple of its top rung; ``0``/``off`` disables
+  canonicalization entirely).
+* :func:`pad_rows` pads the lead axis up to the bucket by repeating the
+  edge row (never zeros: pad rows flow through the real program, and
+  edge values are guaranteed to be in the program's valid domain).
+  Outputs are sliced back to the true row count by the caller.
+
+Safety: padding is applied only where the pad rows provably cannot
+change real rows' results —
+
+* ``map_rows``: rows are independent *by construction* (the cell program
+  is vmapped over the lead axis), so map-rows blocks pad freely;
+* ``map_blocks``: gated on the jaxpr row-independence proof
+  (``segment_compile.cached_rows_independent``) verified at the exact
+  (real, padded) sizes — cross-row programs (block reductions, sorts,
+  block-size literals) keep their exact shapes;
+* ragged ``map_rows`` cells: gated on the same proof applied along the
+  ragged cell axis (``engine._map_rows_ragged``), with the uniform
+  inputs bound as trace params (constant within a row, so the proof's
+  "group" class);
+* reduce/aggregate paths keep their own identity-padding machinery
+  (``engine._segment_pad_rows``: pads are the reduction *identity*, only
+  for recognized monoid plans) and fall back to exact shapes when the
+  monoid cannot absorb pads — value padding through an arbitrary
+  reduction is never sound, so those verbs do not use this module's row
+  padding.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("tensorframes_tpu.bucketing")
+
+ENV_VAR = "TFS_BLOCK_BUCKETS"
+
+# minimum bucket: padding below this costs nothing measurable and keeps
+# tiny uneven tails (1..8 rows) on one executable
+_MIN_BUCKET = 8
+
+# malformed knob values already warned about (warn once per value, not
+# once per verb call)
+_warned: set = set()
+
+
+def _warn_once(raw: str, why: str) -> None:
+    if raw not in _warned:
+        _warned.add(raw)
+        logger.warning(
+            "%s=%r is malformed (%s); falling back to the default "
+            "power-of-two buckets. Use a comma-separated ladder of "
+            "positive ints (e.g. '64,512,4096') or '0' to disable.",
+            ENV_VAR,
+            raw,
+            why,
+        )
+
+
+def bucket_ladder() -> Optional[Tuple[int, ...]]:
+    """The explicit bucket ladder from ``TFS_BLOCK_BUCKETS``, or ``()``
+    for the default power-of-two policy, or ``None`` when bucketing is
+    disabled (``TFS_BLOCK_BUCKETS=0``/``off``).  Read per call: the env
+    knob toggles mid-process (bench A/B legs, tests).
+
+    Malformed values never silently change which executables run: a
+    value that does not parse as a ladder of positive ints (and is not a
+    disable token) logs a warning naming the value and falls back to
+    the DEFAULT policy — the same behavior as not setting the knob."""
+    raw = os.environ.get(ENV_VAR, "").strip()
+    if not raw:
+        return ()
+    if raw.lower() in ("0", "off", "none", "false"):
+        return None
+    try:
+        rungs = sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        _warn_once(raw, "unparseable entry")
+        return ()
+    if not rungs:
+        _warn_once(raw, "no bucket sizes")
+        return ()
+    if rungs[0] <= 0:
+        _warn_once(raw, "non-positive bucket size")
+        return ()
+    return tuple(rungs)
+
+
+def enabled() -> bool:
+    return bucket_ladder() is not None
+
+
+def bucket_for(n: int) -> int:
+    """Smallest bucket >= ``n``: the canonical executed lead-dim size.
+
+    Default ladder is powers of two (floored at a small minimum bucket);
+    an explicit ``TFS_BLOCK_BUCKETS`` ladder is honored verbatim, with
+    counts above its top rung rounded up to a multiple of that rung (so
+    oversized blocks still land on O(1) distinct shapes).  ``n <= 0``
+    and disabled bucketing return ``n`` unchanged."""
+    ladder = bucket_ladder()
+    if ladder is None or n <= 0:
+        return n
+    if ladder:
+        for b in ladder:
+            if b >= n:
+                return b
+        top = ladder[-1]
+        return -(-n // top) * top
+    if n <= _MIN_BUCKET:
+        return _MIN_BUCKET
+    return 1 << (n - 1).bit_length()
+
+
+def pad_rows(arr, target: int):
+    """Pad ``arr``'s lead axis up to ``target`` rows by repeating the
+    edge (last) row.  Host arrays pad in numpy (cheap, runs on the
+    prefetch staging thread); device arrays pad with ``jnp`` on the
+    consumer thread (the Prefetcher contract keeps jit entry points off
+    the worker, and the engine only routes host-resident blocks to the
+    worker).  No-op when already at or above ``target``."""
+    n = arr.shape[0]
+    if n >= target:
+        return arr
+    if isinstance(arr, np.ndarray):
+        return np.concatenate([arr, np.repeat(arr[-1:], target - n, axis=0)])
+    import jax.numpy as jnp
+
+    return jnp.concatenate(
+        [arr, jnp.repeat(arr[-1:], target - n, axis=0)]
+    )

@@ -43,7 +43,7 @@ from ..frame import Column, TensorFrame
 from ..program import Program
 from ..schema import ColumnInfo, Schema
 from ..shape import Shape, ShapeError, UNKNOWN
-from . import prefetch, segment_compile, validation
+from . import bucketing, prefetch, segment_compile, validation
 from .validation import ValidationError
 
 
@@ -192,17 +192,28 @@ class Executor:
         block: Mapping[str, Any],
         infos: Mapping[str, ColumnInfo],
         host_stage: Optional[Mapping[str, Any]] = None,
+        pad_to: Optional[int] = None,
     ) -> Dict[str, jnp.ndarray]:
+        """``pad_to``: bucket target for the block's row axis (shape-
+        canonical execution).  Host blocks pad in numpy *before* the
+        ``device_put``, so the staged transfer already carries the padded
+        signature (prefetch worker included); device-resident blocks pad
+        with a device-side concat on the consumer thread.  Callers slice
+        the outputs back to the true row count."""
         inputs = {}
         for n in program.input_names:
             value = block[program.column_for_input(n)]
             if host_stage and n in host_stage:
-                arr = self._staged_value(host_stage[n], value, n)
-                st = dtypes.coerce(dtypes.from_numpy(arr.dtype))
-                inputs[n] = self._device_value(arr, st)
+                value = self._staged_value(host_stage[n], value, n)
+                st = dtypes.coerce(dtypes.from_numpy(value.dtype))
             else:
                 st = dtypes.coerce(infos[n].scalar_type)
-                inputs[n] = self._device_value(value, st)
+            if pad_to is not None and not isinstance(value, jax.Array):
+                value = bucketing.pad_rows(np.asarray(value), pad_to)
+            value = self._device_value(value, st)
+            if pad_to is not None and isinstance(value, jax.Array):
+                value = bucketing.pad_rows(value, pad_to)
+            inputs[n] = value
         return inputs
 
     def _run_block_program(self, program: Program, inputs) -> Dict[str, Any]:
@@ -325,14 +336,26 @@ class Executor:
             arrays[nm] = np.asarray(block[program.column_for_input(nm)])
             n_rows = arrays[nm].shape[0]
         starts = list(range(0, n_rows, per))
+        # shape-canonical chunks: pad the short tail chunk up to ``per``
+        # so ONE executable serves every chunk (the independence proof
+        # already ran at the tail size; map_rows chunks are independent
+        # by construction).  The pad rows are sliced off the concat.
+        pad_tail = bucketing.enabled() and n_rows % per != 0
 
         def stage(k):
             sl = slice(starts[k], min(starts[k] + per, n_rows))
+            staged = {
+                nm: arrays[nm][sl] for nm in names
+            }
+            if pad_tail and sl.stop - sl.start < per:
+                staged = {
+                    nm: bucketing.pad_rows(v, per) for nm, v in staged.items()
+                }
             return {
                 nm: self._device_value(
-                    arrays[nm][sl], dtypes.coerce(infos[nm].scalar_type)
+                    v, dtypes.coerce(infos[nm].scalar_type)
                 )
-                for nm in names
+                for nm, v in staged.items()
             }
 
         donate = prefetch.donate_inputs()
@@ -347,9 +370,93 @@ class Executor:
             pf_stats["items"] += pf.stats["items"]
             pf_stats["stage_s"] += pf.stats["stage_s"]
             pf_stats["wait_s"] += pf.stats["wait_s"]
-        return {
-            k: jnp.concatenate([o[k] for o in outs]) for k in outs[0]
-        }
+        cat = {k: jnp.concatenate([o[k] for o in outs]) for k in outs[0]}
+        if pad_tail:
+            cat = {k: v[:n_rows] for k, v in cat.items()}
+        return cat
+
+    def _bucket_plan(
+        self,
+        program: Program,
+        frame: TensorFrame,
+        infos,
+        host_stage,
+        rows_level: bool,
+        trim: bool,
+        stream_plans: Sequence[Optional[int]],
+    ) -> List[Optional[int]]:
+        """Per-block bucket targets for shape-canonical execution, or None
+        per block to run the exact shape.
+
+        ``map_rows`` blocks pad freely — the cell program is vmapped over
+        the row axis, so rows are independent by construction.
+        ``map_blocks`` padding is gated on the jaxpr row-independence
+        proof at the exact (real, padded) sizes
+        (``segment_compile.cached_rows_independent``), which rejects
+        cross-row programs, block-size literals, and size-branching
+        python control flow; those keep exact shapes and their per-size
+        executables.  Out of scope, by design: trimmed maps (the output
+        row count is program-defined, so sliced-back padding has no
+        defined contract), host-staged ``map_blocks`` inputs (the staged
+        cell shape is unknown before the stage fn runs, so the proof
+        cannot be posed), and blocks already streamed in canonical chunks
+        (``stream_plans``)."""
+        nb = frame.num_blocks
+        none_plan: List[Optional[int]] = [None] * nb
+        if trim or not bucketing.enabled():
+            return none_plan
+        if host_stage and not rows_level:
+            return none_plan
+        sizes = frame.block_sizes
+        targets = [
+            bucketing.bucket_for(n)
+            if n > 0 and stream_plans[bi] is None
+            else None
+            for bi, n in enumerate(sizes)
+        ]
+        targets = [
+            t if t is not None and t != sizes[bi] else None
+            for bi, t in enumerate(targets)
+        ]
+        if all(t is None for t in targets):
+            return none_plan
+        if not rows_level:
+            # one structural proof across every (real, padded) size pair
+            # this frame will execute
+            proof_sizes = sorted(
+                {sizes[bi] for bi, t in enumerate(targets) if t is not None}
+                | {t for t in targets if t is not None}
+            )
+            specs = {
+                n: jax.ShapeDtypeStruct(
+                    (2,) + tuple(infos[n].cell_shape),
+                    dtypes.coerce(infos[n].scalar_type).np_dtype,
+                )
+                for n in program.input_names
+            }
+            if not segment_compile.cached_rows_independent(
+                program, specs, proof_sizes
+            ):
+                return none_plan
+        return targets
+
+    def _frame_fresh(self, frame: TensorFrame) -> bool:
+        """The ONE freshness rule behind input donation, shared by the
+        dispatch loop and :meth:`warmup` (the warmup executable must
+        carry the same donation aliasing the first real dispatch will,
+        or the persistent-cache keys diverge).
+
+        Residency is a COLUMN property (one array sliced per block), so
+        freshness is decided once per frame, on the consumer thread.  It
+        covers EVERY column, not just the program's inputs, because the
+        worker's ``frame.block()`` slices all of them — and slicing a
+        device column (jax.Array.__getitem__) is a jit entry point,
+        which the Prefetcher contract keeps off the worker.  Donation
+        eligibility only needs the program's input columns host-side,
+        and all-host is a superset of that."""
+        return all(
+            not frame.column(ci.name).is_device for ci in frame.schema
+        )
 
     def map_blocks(
         self,
@@ -405,8 +512,21 @@ class Executor:
         Streamed blocks (``_stream_plan``) prefetch+donate at chunk
         granularity instead."""
         verb = "map_rows" if rows_level else "map_blocks"
-        # plan on the caller thread: _stream_plan may trace (row-
-        # independence proof); all jit entry points stay off the worker
+        if frame.num_rows == 0 and not trim:
+            # empty-frame contract: a non-trimmed map of an empty frame is
+            # an empty frame with the program's inferred output schema —
+            # no trace, no compile, no program execution.  (A TRIMMED map
+            # still applies the program to the empty block below: its
+            # output row count is program-defined, e.g. a per-block
+            # summary row, and inference cannot fabricate those values.)
+            return [
+                self._empty_map_outputs(
+                    program, frame, infos, host_stage, rows_level
+                )
+            ]
+        # plan on the caller thread: _stream_plan and _bucket_plan may
+        # trace (row-independence proofs); all jit entry points stay off
+        # the worker
         plans = [
             self._stream_plan(
                 program, frame.block(bi), infos, host_stage,
@@ -414,18 +534,14 @@ class Executor:
             )
             for bi in range(frame.num_blocks)
         ]
-        donate = prefetch.donate_inputs()
-        # residency is a COLUMN property (one array sliced per block), so
-        # freshness is decided once per frame, on the consumer thread.
-        # It covers EVERY column, not just the program's inputs, because
-        # the worker's ``frame.block()`` slices all of them — and slicing
-        # a device column (jax.Array.__getitem__) is a jit entry point,
-        # which the Prefetcher contract keeps off the worker.  Donation
-        # eligibility only needs the program's input columns host-side,
-        # and all-host is a superset of that.
-        fresh = all(
-            not frame.column(ci.name).is_device for ci in frame.schema
+        # shape-canonical bucket targets (one executable for every block
+        # size of this program); streamed blocks canonicalize at chunk
+        # granularity inside _run_block_streamed instead
+        pads = self._bucket_plan(
+            program, frame, infos, host_stage, rows_level, trim, plans
         )
+        donate = prefetch.donate_inputs()
+        fresh = self._frame_fresh(frame)
         # only spin up a staging thread when some block will actually
         # stage on it; otherwise (device-resident frame, or every block
         # streamed at chunk level) keep the plain consumer loop
@@ -435,7 +551,7 @@ class Executor:
             if plans[bi] is not None:
                 return None  # streamed inline, chunk-level prefetch
             return self._device_inputs(
-                program, frame.block(bi), infos, host_stage
+                program, frame.block(bi), infos, host_stage, pad_to=pads[bi]
             )
 
         pf = prefetch.Prefetcher(stage, frame.num_blocks) if to_stage else None
@@ -460,7 +576,8 @@ class Executor:
                     staged
                     if staged is not None
                     else self._device_inputs(  # device-resident block
-                        program, frame.block(bi), infos, host_stage
+                        program, frame.block(bi), infos, host_stage,
+                        pad_to=pads[bi],
                     )
                 )
                 if rows_level:
@@ -470,6 +587,11 @@ class Executor:
                 else:
                     outs = self._run_block_program(program, inputs)
                 del inputs, staged  # drop staged refs (donation hygiene)
+                if pads[bi] is not None:
+                    # bucket-padded execution: slice the pad rows back off
+                    # (row-independence guarantees real rows' values are
+                    # bit-identical to the exact-shape path)
+                    outs = {k: v[:n_rows] for k, v in outs.items()}
             if rows_level:
                 pass  # row programs are per-cell; no block row-count check
             elif not trim:
@@ -520,6 +642,57 @@ class Executor:
             },
         )
         return out_blocks
+
+    def _empty_map_outputs(
+        self,
+        program: Program,
+        frame: TensorFrame,
+        infos,
+        host_stage,
+        rows_level: bool,
+    ) -> Dict[str, np.ndarray]:
+        """Zero-row output block for the empty-frame map contract, shaped
+        by ``Program.analyze`` (host-staged inputs run their stage fn over
+        the zero cells so the staged cell shape is authoritative)."""
+        specs: Dict[str, Any] = {}
+        block0 = frame.block(0)  # the one empty block: real (0, *cell)
+        # column slices, so shape-preserving stage fns infer correctly
+        for n in program.input_names:
+            if host_stage and n in host_stage:
+                try:
+                    arr = self._staged_value(
+                        host_stage[n], block0[program.column_for_input(n)], n
+                    )
+                except ValidationError:
+                    raise
+                except Exception as e:
+                    raise ValidationError(
+                        f"host_stage for input {n!r} failed on an empty "
+                        f"frame ({e!r}); a stage fn must accept zero cells "
+                        f"for the empty-frame contract to apply."
+                    ) from e
+                st = dtypes.coerce(dtypes.from_numpy(arr.dtype))
+                cell = arr.shape[1:]
+            else:
+                st = dtypes.coerce(infos[n].scalar_type)
+                cell = tuple(infos[n].cell_shape)
+            specs[n] = (st, cell if rows_level else (0,) + cell)
+        outs: Dict[str, np.ndarray] = {}
+        for s in program.analyze(specs):
+            if not s.is_output:
+                continue
+            shape = tuple(s.shape)
+            if rows_level:
+                shape = (0,) + shape
+            elif not shape or shape[0] != 0:
+                raise ValidationError(
+                    f"map_blocks: output {s.name!r} has inferred shape "
+                    f"{shape} for an empty block; a non-trimmed map must "
+                    f"preserve the row count (use map_blocks_trimmed to "
+                    f"change it)."
+                )
+            outs[s.name] = np.zeros(shape, dtype=s.scalar_type.np_dtype)
+        return outs
 
     def map_rows(
         self,
@@ -572,6 +745,62 @@ class Executor:
         independent under vmap, so padding is semantics-safe)."""
         return program.vmapped()(arrays)
 
+    def _ragged_pad_ok(
+        self,
+        program: Program,
+        ragged_name: str,
+        rcells: Sequence[np.ndarray],
+        uniform: Mapping[str, np.ndarray],
+        sizes: Sequence[int],
+    ) -> bool:
+        """Whether the single ragged input's cells may pad along their
+        lead (ragged) axis: jaxpr-proven elementwise along that axis, at
+        the exact (real, bucketed) lengths.
+
+        The proof is :func:`segment_compile.rows_independent_at` posed on
+        the *cell* program with the ragged axis as the lead dim and every
+        uniform input bound as a trace param — within one row the uniform
+        inputs are constants w.r.t. the cell axis, which is exactly the
+        proof's "group" class.  A program that reduces, sorts, or
+        position-indexes along the ragged axis (``v.sum()``,
+        ``v[::-1]``...) fails and keeps the exact per-shape buckets."""
+        rest = {c.shape[1:] for c in rcells}
+        if len(rest) != 1:
+            return False  # trailing dims ragged too: exact buckets
+        st = np.asarray(rcells[0]).dtype
+        key = (
+            "ragged-pad",
+            ragged_name,
+            tuple(sorted(sizes)),
+            rest.pop(),
+            str(st),
+            tuple(sorted((u, a.shape[1:], str(a.dtype)) for u, a in uniform.items())),
+        )
+        cache = program._derived
+        if key in cache:
+            return cache[key]
+        try:
+            dummies = {
+                u: np.zeros(a.shape[1:], a.dtype) for u, a in uniform.items()
+            }
+            probe = Program(
+                program._fn,
+                program.input_names + program.param_names,
+                program._declared_fetches,
+                None,
+                {**program.params, **dummies},
+            )
+            specs = {
+                ragged_name: jax.ShapeDtypeStruct(
+                    (2,) + rcells[0].shape[1:], st
+                )
+            }
+            ok = segment_compile.rows_independent_at(probe, specs, sizes)
+        except Exception:
+            ok = False
+        cache[key] = ok
+        return ok
+
     def _map_rows_ragged(
         self,
         program: Program,
@@ -587,7 +816,16 @@ class Executor:
         ``DataOps.inferPhysicalShape`` L105-144); a compiled-program engine
         instead groups rows by their concrete cell shapes and runs ONE
         vmapped execution per distinct shape (bounded recompilation: one
-        trace per bucket shape, reused across blocks and calls)."""
+        trace per bucket shape, reused across blocks and calls).
+
+        Round 7 tightens "bounded" from O(distinct shapes) — unbounded if
+        the data does not cooperate — to O(log max-dim): when the program
+        is provably elementwise along the ragged axis
+        (:meth:`_ragged_pad_ok`), rows are grouped by the *geometric
+        bucket* of their ragged lead dim (``bucketing.bucket_for``), each
+        cell padded up to the bucket by edge repetition, and each output
+        row sliced back to its own true length — the pad elements are the
+        validity mask's complement, computed and discarded."""
         n = frame.num_rows
         cells: Dict[str, List[np.ndarray]] = {}
         uniform: Dict[str, np.ndarray] = {}
@@ -609,9 +847,26 @@ class Executor:
                     st.np_dtype, copy=False
                 )
 
+        # cell-axis bucket padding: single ragged input, pads proven safe
+        pad_lengths: Dict[int, int] = {}
+        if bucketing.enabled() and len(ragged_names) == 1:
+            r = ragged_names[0]
+            lengths = sorted({c.shape[0] for c in cells[r] if c.shape[0] > 0})
+            targets = {d: bucketing.bucket_for(d) for d in lengths}
+            if any(t != d for d, t in targets.items()):
+                proof_sizes = sorted(set(lengths) | set(targets.values()))
+                if self._ragged_pad_ok(
+                    program, r, cells[r], uniform, proof_sizes
+                ):
+                    pad_lengths = {d: t for d, t in targets.items() if t != d}
+
         buckets: Dict[Tuple, List[int]] = {}
         for i in range(n):
-            key = tuple(cells[r][i].shape for r in ragged_names)
+            key = tuple(
+                (pad_lengths.get(cells[r][i].shape[0], cells[r][i].shape[0]),)
+                + cells[r][i].shape[1:]
+                for r in ragged_names
+            )
             buckets.setdefault(key, []).append(i)
 
         out_cells: Dict[str, List[Any]] = {}
@@ -619,17 +874,52 @@ class Executor:
             idxs = buckets[key]
             arrays: Dict[str, jnp.ndarray] = {}
             for r in ragged_names:
-                arrays[r] = jnp.asarray(np.stack([cells[r][i] for i in idxs]))
+                target = key[0][0] if pad_lengths else None
+                arrays[r] = jnp.asarray(
+                    np.stack(
+                        [
+                            bucketing.pad_rows(cells[r][i], target)
+                            if target is not None
+                            else cells[r][i]
+                            for i in idxs
+                        ]
+                    )
+                )
             for u, arr in uniform.items():
                 arrays[u] = jnp.asarray(arr[idxs])
             outs = self._run_rows_bucket(program, arrays)
-            _check_shape_hints(program, outs, "map_rows", cell_level=True)
-            for name, v in outs.items():
-                host = np.asarray(v)
-                if name not in out_cells:
-                    out_cells[name] = [None] * n
-                for j, i in enumerate(idxs):
-                    out_cells[name][i] = host[j]
+            hosts = {name: np.asarray(v) for name, v in outs.items()}
+            if not pad_lengths:
+                _check_shape_hints(program, outs, "map_rows", cell_level=True)
+                for name, host in hosts.items():
+                    if name not in out_cells:
+                        out_cells[name] = [None] * n
+                    for j, i in enumerate(idxs):
+                        out_cells[name][i] = host[j]
+                continue
+            # padded bucket: every output tracks the ragged axis on dim 0
+            # (guaranteed by the _ragged_pad_ok proof) — slice each row's
+            # outputs back to its own true length, and hint-check once per
+            # distinct true length (shapes differ within the bucket)
+            hint_checked: set = set()
+            for j, i in enumerate(idxs):
+                d = cells[ragged_names[0]][i].shape[0]
+                row = {
+                    name: host[j][:d] if d < host[j].shape[0] else host[j]
+                    for name, host in hosts.items()
+                }
+                if program.shape_hints and d not in hint_checked:
+                    _check_shape_hints(
+                        program,
+                        {name: cell[None] for name, cell in row.items()},
+                        "map_rows",
+                        cell_level=True,
+                    )
+                    hint_checked.add(d)
+                for name, cell in row.items():
+                    if name not in out_cells:
+                        out_cells[name] = [None] * n
+                    out_cells[name][i] = cell
 
         from ..frame import _column_from_cells
 
@@ -642,6 +932,118 @@ class Executor:
             if cname not in shadowed:
                 cols.append(frame.column(cname))
         return TensorFrame(cols, frame.offsets)
+
+    def warmup(
+        self,
+        program: Program,
+        frame: TensorFrame,
+        rows_level: bool = False,
+        host_stage: Optional[Mapping[str, Any]] = None,
+    ) -> List[str]:
+        """AOT-compile the executables the map verbs will actually run
+        for ``frame``, returning their fingerprints.
+
+        "Actually" is load-bearing: the executed sizes come from the
+        same :meth:`_bucket_plan` the verbs use (a cross-row program
+        keeps its exact per-size shapes — bucketed signatures would be
+        dead weight), and when the verbs would take the donating entry
+        (fresh host frame on a donation-capable backend) the donated jit
+        entry itself is lowered, so the persistent-cache key matches the
+        first real dispatch.  ``host_stage`` inputs are probed on one
+        row (zero rows for an empty frame) to learn the staged cell
+        shape.  Not covered: the chunked-streaming path's chunk-sized
+        executables (blocks past ``stream_chunk_bytes`` compile on first
+        use).
+
+        With the persistent compilation cache configured
+        (``TFS_COMPILE_CACHE``), this is the cold-start path: a fresh
+        process warms every executable from disk before the first block
+        arrives, paying deserialization instead of XLA.  Without the
+        cache it duplicates compile work — configure the cache first."""
+        host_stage = _with_prelude(program, host_stage)
+        verb = "map_rows" if rows_level else "map_blocks"
+        if rows_level and any(
+            frame.column(program.column_for_input(n)).is_ragged
+            and not (host_stage and n in host_stage)
+            for n in program.input_names
+        ):
+            raise ValidationError(
+                "warmup: ragged columns are not supported — ragged "
+                "map_rows executables are keyed by (rows-per-bucket, "
+                "padded cell shape), which depends on the data; they "
+                "compile on first use (and land in the persistent cache "
+                "like everything else)."
+            )
+        infos = validation.check_map_inputs(
+            program, frame, verb, host_staged=host_stage or ()
+        )
+        # staged cell shapes: probe each stage fn on (at most) one row
+        staged_specs: Dict[str, Tuple[Any, Tuple[int, ...]]] = {}
+        if host_stage:
+            block0 = frame.block(0)
+            for n in program.input_names:
+                if n not in host_stage:
+                    continue
+                value = block0[program.column_for_input(n)][:1]
+                arr = self._staged_value(host_stage[n], value, n)
+                staged_specs[n] = (
+                    dtypes.coerce(dtypes.from_numpy(arr.dtype)),
+                    arr.shape[1:],
+                )
+        # mirror the dispatch exactly: blocks the runtime would STREAM
+        # compile chunk-sized executables on first use (documented gap) —
+        # warming their whole-block signature would be dead weight
+        plans = [
+            self._stream_plan(
+                program, frame.block(bi), infos, host_stage,
+                check_independence=not rows_level,
+            )
+            for bi in range(frame.num_blocks)
+        ]
+        pads = self._bucket_plan(
+            program, frame, infos, host_stage, rows_level, False, plans
+        )
+        exec_sizes = sorted(
+            {
+                pads[bi] if pads[bi] is not None else n
+                for bi, n in enumerate(frame.block_sizes)
+                if n > 0 and plans[bi] is None
+            }
+        )
+        if not exec_sizes:
+            # nothing block-sized will ever dispatch: every block streams
+            # (chunk executables compile on first use), or the frame is
+            # empty (the non-trimmed map verbs short-circuit without
+            # compiling) — warming any signature would be dead weight
+            return []
+        # match the runtime's donation choice (_map_dispatch): donated
+        # entries lower to a different persistent-cache key
+        donate = prefetch.donate_inputs() and self._frame_fresh(frame)
+        run = (
+            self._rows_run(program, donate)
+            if rows_level
+            else self._block_run(program, donate)
+        )
+        raw = getattr(run, "raw_jit", None) or (
+            program._vmap_raw() if rows_level else program._jit_raw()
+        )
+        fps = []
+        for n_rows in exec_sizes:
+            specs = {}
+            for n in program.input_names:
+                if n in staged_specs:
+                    st, cell = staged_specs[n]
+                else:
+                    st = dtypes.coerce(infos[n].scalar_type)
+                    cell = tuple(infos[n].cell_shape)
+                specs[n] = jax.ShapeDtypeStruct(
+                    (n_rows,) + tuple(cell), st.np_dtype
+                )
+            fn = program.aot_compile_raw(
+                raw, specs, ("aot", bool(rows_level), donate)
+            )
+            fps.append(fn.fingerprint)
+        return fps
 
     def _column_array(
         self, frame: TensorFrame, col_name: str, ci: ColumnInfo
@@ -910,6 +1312,50 @@ class Executor:
                     f"aggregate: column {k!r} is both a grouping key and a "
                     f"reduced column"
                 )
+
+        if frame.num_rows == 0:
+            # empty-frame contract: zero groups, so an empty result frame
+            # with the key columns and the program's inferred output cells
+            # — the block-reduction contract is still validated (a broken
+            # program must fail the same way on 0 rows as on N)
+            probe_summaries = program.analyze(
+                {
+                    f"{b}_input": (
+                        dtypes.coerce(reduced[b].scalar_type),
+                        (1,) + tuple(reduced[b].cell_shape),
+                    )
+                    for b in bases
+                }
+            )
+            validation.check_reduce_blocks_outputs(
+                reduced, probe_summaries, verb="aggregate"
+            )
+            span.mark("validate_and_group_index")
+            cols = []
+            for kname in grouped.keys:
+                kst = frame.schema[kname].scalar_type
+                kdata = np.zeros((0,), dtype=kst.np_dtype)
+                cols.append(
+                    Column(
+                        ColumnInfo(kname, kst, Shape((UNKNOWN,))), kdata
+                    )
+                )
+            for s in probe_summaries:
+                if not s.is_output:
+                    continue
+                cell = tuple(s.shape)
+                arr = np.zeros((0,) + cell, dtype=s.scalar_type.np_dtype)
+                cols.append(
+                    Column(
+                        ColumnInfo(
+                            s.name,
+                            s.scalar_type,
+                            Shape(arr.shape).with_lead(UNKNOWN),
+                        ),
+                        arr,
+                    )
+                )
+            return TensorFrame(cols)
 
         # --- device-side segmented reduction (dense monoid fast path) ---
         seg = self._aggregate_segment(program, grouped, reduced, bases, span)
@@ -1471,3 +1917,20 @@ def aggregate(
     reference ``core.py:319-336``)."""
     program = _wrap(fn, fetches, shapes=shapes)
     return _resolve(engine).aggregate(program, grouped)
+
+
+def warmup(
+    fn,
+    frame: TensorFrame,
+    rows_level: bool = False,
+    fetches: Optional[Sequence[str]] = None,
+    feed_dict: Optional[Mapping[str, str]] = None,
+    host_stage: Optional[Mapping[str, Any]] = None,
+    engine: Optional[Executor] = None,
+) -> List[str]:
+    """AOT-compile the map-verb executables ``fn`` will run over
+    ``frame`` (persistent-cache cold start; see ``Executor.warmup``)."""
+    program = Program.wrap(fn, fetches, feed_dict)
+    return _resolve(engine).warmup(
+        program, frame, rows_level=rows_level, host_stage=host_stage
+    )

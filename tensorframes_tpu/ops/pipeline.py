@@ -597,6 +597,27 @@ class Pipeline:
                     )
             return frame
 
+    def _entry_layout(self) -> Tuple[Dict[str, Any], bool]:
+        """``name -> (column data, effective entry dtype)`` plus whether
+        every entry column is host-resident — the ONE walk behind both
+        :meth:`_entry_cols` (which stages the data) and :meth:`warmup`
+        (which builds matching specs), so a warmed executable's
+        signature can never drift from the staged one.  Device-resident
+        columns keep their own dtype (they are staged untouched;
+        ``_body`` casts per block) and disable donation."""
+        layout: Dict[str, Any] = {}
+        all_host = True
+        for name in self._needed_source_cols():
+            c = self._frame.column(name)
+            data = c.data
+            if is_device_array(data):
+                all_host = False
+                dt = data.dtype
+            else:
+                dt = dtypes.coerce(c.info.scalar_type).np_dtype
+            layout[name] = (data, dt)
+        return layout, all_host
+
     def _entry_cols(self) -> Tuple[Dict[str, Any], bool]:
         """Source columns for the trace, staged onto the device.
 
@@ -609,18 +630,13 @@ class Pipeline:
         state HBM holds one staged set).  Device-resident (cached)
         columns are shared frame state and disable donation; mesh
         placement keeps its own sharded path."""
+        layout, all_host = self._entry_layout()
         cols = {}
-        donate = True
-        for name in self._needed_source_cols():
-            c = self._frame.column(name)
-            data = c.data
+        for name, (data, dt) in layout.items():
             if not is_device_array(data):
-                st = dtypes.coerce(c.info.scalar_type)
                 data = np.asarray(data)
-                if data.dtype != st.np_dtype:
-                    data = data.astype(st.np_dtype)
-            else:
-                donate = False
+                if data.dtype != dt:
+                    data = data.astype(dt)
             if self._mesh_mode:
                 # rows land sharded over the engine's data axis; GSPMD
                 # propagates from these input shardings through the trace
@@ -629,8 +645,51 @@ class Pipeline:
         if self._mesh_mode or not cols:
             return cols, False
         return prefetch.stage_columns(cols), (
-            donate and prefetch.donate_inputs()
+            all_host and prefetch.donate_inputs()
         )
+
+    def warmup(self) -> "Pipeline":
+        """AOT-lower and compile the fused ``run()``/``collect()``
+        executable at the frame's entry signature without dispatching it
+        — the pipeline face of the persistent-executable-cache cold
+        start (``TFS_COMPILE_CACHE`` / ``Program.aot_compile``).
+
+        With the cache configured, a fresh serving process calls
+        ``pipe.warmup()`` before traffic arrives and the fused
+        executable deserializes from disk instead of running XLA; the
+        subsequent ``run()`` re-traces (cheap) and fetches the same
+        backend artifact.  NOT covered: ``iterate()`` compiles a
+        different executable (the chain scanned over steps) — its first
+        call in a cached process still fetches from disk *if a previous
+        process ran the same iterate*, but this method does not prime
+        it.  Single-process / mesh-less chains only: a mesh-global
+        chain's executable depends on the live sharding, which staging
+        establishes."""
+        if not self._stages:
+            raise ValidationError("pipeline.warmup: empty pipeline")
+        if self._mesh_mode:
+            raise ValidationError(
+                "pipeline.warmup: mesh-global chains compile against live "
+                "shardings; warm them by running once."
+            )
+        layout, all_host = self._entry_layout()
+        donate = bool(layout) and all_host and prefetch.donate_inputs()
+        specs = {
+            name: jax.ShapeDtypeStruct(tuple(np.shape(data)), dt)
+            for name, (data, dt) in layout.items()
+        }
+        if donate not in self._compiled:
+            self._compiled[donate] = jax.jit(
+                lambda cols, params_list: self._body(cols, params_list),
+                **({"donate_argnums": (0,)} if donate else {}),
+            )
+        param_specs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(jnp.shape(a), jnp.asarray(a).dtype),
+            self._params_list(),
+        )
+        with observability.suppress_trace_count():
+            self._compiled[donate].lower(specs, param_specs).compile()
+        return self
 
     def collect(self):
         """``run()`` + host materialisation (the one sync)."""

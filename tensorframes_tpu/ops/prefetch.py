@@ -31,6 +31,15 @@ frames, chained verb outputs) are shared state and must never be donated
 the non-donating executable.  Staged buffers are handed to the donating
 executable exactly once and the reference is dropped immediately after.
 
+Shape-canonical staging (round 7, ``ops/bucketing.py``): when block
+bucketing applies, the engine's stage functions pad the row axis ON THE
+HOST before the ``device_put``, so the staged buffer already carries the
+padded signature the (single, shared) executable expects — the transfer
+moves the padded bytes and no device-side reshape sits between staging
+and dispatch.  Padded staged buffers remain donation-eligible: they are
+fresh per block by construction, pad rows included, and the donating
+executable consumes exactly the padded shape it was compiled for.
+
 Knobs:
 
 * ``TFS_PREFETCH_BLOCKS`` — staging window depth (default 2; ``0``

@@ -280,9 +280,12 @@ def _flatten(closed, var_ids: Dict[int, int], shapes: Dict[int, tuple],
 
 
 def _trace(program, specs, param_specs):
-    closed, out_shape = jax.make_jaxpr(
-        lambda kw, pr: program.call(kw, pr), return_shape=True
-    )(specs, param_specs)
+    from .. import observability
+
+    with observability.suppress_trace_count():
+        closed, out_shape = jax.make_jaxpr(
+            lambda kw, pr: program.call(kw, pr), return_shape=True
+        )(specs, param_specs)
     var_ids: Dict[int, int] = {}
     shapes: Dict[int, tuple] = {}
     consts: List[Any] = []

@@ -1,0 +1,457 @@
+"""Shape-canonical execution (round 7): block/ragged bucket padding,
+the persistent executable cache, and the retrace counters that prove
+compile counts instead of asserting them.
+
+Covers the acceptance criteria of ISSUE 2: one executable serves every
+block size of an uneven frame for the map verbs (trace counter == 1),
+ragged ``map_rows`` traces O(log max-dim) buckets, padded outputs are
+bit-identical to the exact-shape path for all six verbs, prefetch
+donation still holds under bucketing, and a cleared-cache recompile with
+``TFS_COMPILE_CACHE`` set reports a persistent-cache hit."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import compile_cache, observability as obs
+from tensorframes_tpu.ops import bucketing
+
+
+def _uneven_frame(rows=1030, blocks=4, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    f = tfs.TensorFrame.from_arrays(
+        {
+            "x": rng.rand(rows, d).astype(np.float32),
+            "w": rng.rand(rows).astype(np.float32),
+        },
+        num_blocks=blocks,
+    )
+    assert len(set(f.block_sizes)) > 1, "frame must be uneven"
+    return f
+
+
+# ---------------------------------------------------------------------------
+# bucket policy
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_for_default_powers_of_two():
+    assert bucketing.bucket_for(1) == 8  # floored at the minimum bucket
+    assert bucketing.bucket_for(8) == 8
+    assert bucketing.bucket_for(9) == 16
+    assert bucketing.bucket_for(257) == 512
+    assert bucketing.bucket_for(512) == 512
+    assert bucketing.bucket_for(0) == 0
+
+
+def test_bucket_ladder_env_override(monkeypatch):
+    monkeypatch.setenv("TFS_BLOCK_BUCKETS", "4,16")
+    assert bucketing.bucket_ladder() == (4, 16)
+    assert bucketing.bucket_for(3) == 4
+    assert bucketing.bucket_for(10) == 16
+    # above the top rung: round up to a multiple of it
+    assert bucketing.bucket_for(40) == 48
+    monkeypatch.setenv("TFS_BLOCK_BUCKETS", "0")
+    assert not bucketing.enabled()
+    assert bucketing.bucket_for(257) == 257
+    monkeypatch.delenv("TFS_BLOCK_BUCKETS")
+    assert bucketing.enabled()
+
+
+# ---------------------------------------------------------------------------
+# one executable per program on uneven frames (the tentpole claim)
+# ---------------------------------------------------------------------------
+
+
+def test_uneven_map_blocks_single_trace():
+    frame = _uneven_frame()
+    c0 = obs.counters()
+    out = tfs.map_blocks(lambda x: {"y": x * 2.0 + 1.0}, frame)
+    d = obs.counters_delta(c0)
+    assert d["program_traces"] == 1, d
+    np.testing.assert_array_equal(
+        np.asarray(out.column("y").data),
+        np.asarray(frame.column("x").data) * np.float32(2.0)
+        + np.float32(1.0),
+    )
+    assert out.offsets == frame.offsets
+
+
+def test_uneven_map_rows_single_trace():
+    frame = _uneven_frame()
+    c0 = obs.counters()
+    out = tfs.map_rows(lambda x, w: {"s": x.sum() * w}, frame)
+    d = obs.counters_delta(c0)
+    assert d["program_traces"] == 1, d
+    # numpy oracle: f32 summation order differs from XLA's, so allclose
+    # here; engine-exact bit-identity is pinned in the six-verb test
+    np.testing.assert_allclose(
+        np.asarray(out.column("s").data),
+        np.asarray(frame.column("x").data).sum(axis=1)
+        * np.asarray(frame.column("w").data),
+        rtol=1e-5,
+    )
+
+
+def test_unbucketed_traces_once_per_block_size(monkeypatch):
+    monkeypatch.setenv("TFS_BLOCK_BUCKETS", "0")
+    frame = _uneven_frame()
+    n_sizes = len(set(frame.block_sizes))
+    c0 = obs.counters()
+    tfs.map_blocks(lambda x: {"y": x * 2.0}, frame)
+    d = obs.counters_delta(c0)
+    assert d["program_traces"] == n_sizes, d
+
+
+def test_compile_count_regression_fence():
+    """CI fence: the map-verb trace count on an uneven frame must never
+    regress above the bucket bound (== 1 when every block lands on one
+    bucket).  If this fails, shape canonicalization broke."""
+    frame = _uneven_frame(rows=1030, blocks=4)
+    sizes = {bucketing.bucket_for(n) for n in frame.block_sizes}
+    assert len(sizes) == 1  # 258/257 both round to 512
+    for verb, fn in (
+        ("map_blocks", lambda f, p: tfs.map_blocks(p, f)),
+        ("map_rows", lambda f, p: tfs.map_rows(p, f)),
+    ):
+        c0 = obs.counters()
+        fn(frame, lambda x: {"y": x + 3.0})
+        d = obs.counters_delta(c0)
+        assert d["program_traces"] <= len(sizes), (verb, d)
+
+
+def test_cross_row_program_keeps_exact_shapes():
+    """A cross-row map_blocks program (block mean) must NOT be padded —
+    the row-independence proof rejects it — and stays exact per size."""
+    frame = _uneven_frame()
+    x = np.asarray(frame.column("x").data)
+    c0 = obs.counters()
+    out = tfs.map_blocks(lambda x: {"y": x - x.mean(axis=0)}, frame)
+    d = obs.counters_delta(c0)
+    assert d["program_traces"] == len(set(frame.block_sizes)), d
+    expect = np.concatenate(
+        [
+            x[lo:hi] - x[lo:hi].mean(axis=0)
+            for lo, hi in zip(frame.offsets, frame.offsets[1:])
+        ]
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.column("y").data), expect, rtol=1e-4, atol=1e-6
+    )
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: bucketed vs exact paths, all six verbs
+# ---------------------------------------------------------------------------
+
+
+def _six_verb_results(frame, grouped_key="k"):
+    res = {}
+    res["map_blocks"] = np.asarray(
+        tfs.map_blocks(lambda x: {"y": x * 3.0 + 0.5}, frame)
+        .column("y")
+        .data
+    )
+    res["map_blocks_trimmed"] = np.asarray(
+        tfs.map_blocks_trimmed(
+            lambda x: {"m": x.sum(axis=0, keepdims=True)}, frame
+        )
+        .column("m")
+        .data
+    )
+    res["map_rows"] = np.asarray(
+        tfs.map_rows(lambda x: {"s": x.sum() * 2.0}, frame).column("s").data
+    )
+    res["reduce_rows"] = tfs.reduce_rows(
+        lambda x_1, x_2: {"x": x_1 + x_2}, frame
+    )["x"]
+    res["reduce_blocks"] = tfs.reduce_blocks(
+        lambda x_input: {"x": x_input.sum(axis=0)}, frame
+    )["x"]
+    agg = tfs.aggregate(
+        lambda x_input: {"x": x_input.sum(axis=0)},
+        frame.group_by(grouped_key),
+    )
+    res["aggregate"] = np.asarray(agg.column("x").data)
+    return res
+
+
+def test_bucketed_bit_identical_to_exact_all_six_verbs(monkeypatch):
+    rng = np.random.RandomState(7)
+    frame = tfs.TensorFrame.from_arrays(
+        {
+            "x": rng.rand(205, 4).astype(np.float32),
+            "k": rng.randint(0, 5, size=205).astype(np.int64),
+        },
+        num_blocks=4,
+    )
+    assert len(set(frame.block_sizes)) > 1
+    bucketed = _six_verb_results(frame)
+    monkeypatch.setenv("TFS_BLOCK_BUCKETS", "0")
+    exact = _six_verb_results(frame)
+    for verb in exact:
+        np.testing.assert_array_equal(bucketed[verb], exact[verb]), verb
+
+
+# ---------------------------------------------------------------------------
+# ragged map_rows: O(log max-dim) buckets
+# ---------------------------------------------------------------------------
+
+
+def _ragged_frame(lengths, seed=0, blocks=3):
+    rng = np.random.RandomState(seed)
+    cells = [rng.rand(k).astype(np.float64) for k in lengths]
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays(
+            {"v": cells, "w": np.arange(float(len(cells)))},
+            num_blocks=blocks,
+        )
+    )
+    return cells, frame
+
+
+def test_ragged_bucket_padding_caps_traces():
+    lengths = list(range(1, 21))  # 20 distinct shapes
+    cells, frame = _ragged_frame(lengths)
+    c0 = obs.counters()
+    out = tfs.map_rows(lambda v, w: {"z": v * 2.0 + w}, frame)
+    d = obs.counters_delta(c0)
+    # buckets {8, 16, 32}: O(log max-dim), not O(distinct shapes)
+    assert d["program_traces"] <= 6, d
+    for i, (got, c) in enumerate(zip(out.column("z").cells(), cells)):
+        np.testing.assert_array_equal(got, c * 2.0 + float(i))
+
+
+def test_ragged_bucketed_bit_identical_to_exact(monkeypatch):
+    lengths = [3, 9, 5, 17, 2, 11, 7, 30]
+    cells, frame = _ragged_frame(lengths, seed=3)
+    bucketed = tfs.map_rows(lambda v: {"z": v * v + 1.0}, frame)
+    monkeypatch.setenv("TFS_BLOCK_BUCKETS", "0")
+    exact = tfs.map_rows(lambda v: {"z": v * v + 1.0}, frame)
+    for b, e in zip(bucketed.column("z").cells(), exact.column("z").cells()):
+        np.testing.assert_array_equal(b, e)
+
+
+def test_ragged_cross_element_program_keeps_exact_buckets():
+    """A cell program that reduces over the ragged axis cannot pad — the
+    ragged-axis proof rejects it and every distinct shape traces."""
+    lengths = [2, 3, 5, 9, 4]
+    cells, frame = _ragged_frame(lengths, seed=5)
+    c0 = obs.counters()
+    out = tfs.map_rows(lambda v: {"s": v.sum()}, frame)
+    d = obs.counters_delta(c0)
+    assert d["program_traces"] == len(set(lengths)), d
+    np.testing.assert_allclose(
+        np.asarray(out.column("s").data), [c.sum() for c in cells]
+    )
+
+
+def test_ragged_2d_cells_pad_lead_axis_only():
+    rng = np.random.RandomState(9)
+    cells = [rng.rand(k, 3) for k in (2, 5, 9, 2, 17)]
+    frame = tfs.analyze(
+        tfs.TensorFrame.from_arrays({"m": cells}, num_blocks=1)
+    )
+    c0 = obs.counters()
+    out = tfs.map_rows(lambda m: {"z": m * 2.0}, frame)
+    d = obs.counters_delta(c0)
+    assert d["program_traces"] <= 3, d  # buckets {8, 32} (+1 slack)
+    for got, c in zip(out.column("z").cells(), cells):
+        np.testing.assert_array_equal(got, c * 2.0)
+
+
+# ---------------------------------------------------------------------------
+# prefetch + donation under bucketing
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_donation_bit_identity_under_bucketing(monkeypatch):
+    monkeypatch.setenv("TFS_DONATE", "1")
+    monkeypatch.setenv("TFS_PREFETCH_BLOCKS", "2")
+    frame = _uneven_frame(rows=523, blocks=3, d=16, seed=11)
+    x = np.asarray(frame.column("x").data)
+    out = tfs.map_blocks(lambda x: {"y": x * 2.0}, frame)
+    np.testing.assert_array_equal(
+        np.asarray(out.column("y").data), x * np.float32(2.0)
+    )
+    out_r = tfs.map_rows(lambda x: {"s": x.sum()}, frame)
+    np.testing.assert_allclose(
+        np.asarray(out_r.column("s").data), x.sum(axis=1), rtol=1e-5
+    )
+
+
+def test_streamed_chunks_canonicalize_tail(monkeypatch):
+    """Chunked h2d streaming pads the short tail chunk: one executable,
+    outputs bit-identical."""
+    monkeypatch.setenv("TFS_PREFETCH_BLOCKS", "2")
+    ex = tfs.Executor()
+    ex.stream_chunk_bytes = 4096  # force streaming on a small frame
+    rng = np.random.RandomState(13)
+    frame = tfs.TensorFrame.from_arrays(
+        {"x": rng.rand(1000, 8).astype(np.float32)}, num_blocks=1
+    )
+    prog = tfs.Program.wrap(lambda x: {"y": x + 1.0}, fetches=["y"])
+    c0 = obs.counters()
+    out = ex.map_blocks(prog, frame)
+    d = obs.counters_delta(c0)
+    assert d["program_traces"] == 1, d  # tail chunk shares the executable
+    np.testing.assert_array_equal(
+        np.asarray(out.column("y").data),
+        np.asarray(frame.column("x").data) + np.float32(1.0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# persistent executable cache + AOT warmup
+# ---------------------------------------------------------------------------
+
+
+def test_persistent_cache_hit_after_cache_clear(tmp_path):
+    import jax
+
+    assert compile_cache.configure(str(tmp_path / "cc"))
+    try:
+        frame = tfs.TensorFrame.from_arrays(
+            {"x": np.arange(100, dtype=np.float32)}, num_blocks=1
+        )
+        tfs.map_blocks(lambda x: {"y": x * 7.0}, frame)
+        jax.clear_caches()  # drop every in-memory executable
+        c0 = obs.counters()
+        tfs.map_blocks(lambda x: {"y": x * 7.0}, frame)
+        d = obs.counters_delta(c0)
+        # the recompile fetched at least the program executable from disk
+        assert d["persistent_cache_hits"] >= 1, d
+    finally:
+        compile_cache.deconfigure()
+
+
+def test_warmup_aot_compiles_bucket_signature(tmp_path):
+    import jax
+
+    assert compile_cache.configure(str(tmp_path / "cc"))
+    try:
+        frame = _uneven_frame(rows=301, blocks=3, d=4, seed=17)
+        prog = tfs.Program.wrap(lambda x: {"y": x * 4.0}, fetches=["y"])
+        fps = tfs.warmup(prog, frame)
+        assert len(fps) == 1  # every block size rounds to one bucket
+        # same program source in a "fresh replica" -> same fingerprint,
+        # and its warmup is a pure persistent-cache fetch
+        jax.clear_caches()
+        prog2 = tfs.Program.wrap(lambda x: {"y": x * 4.0}, fetches=["y"])
+        c0 = obs.counters()
+        fps2 = tfs.warmup(prog2, frame)
+        d = obs.counters_delta(c0)
+        assert fps2 == fps
+        assert d["persistent_cache_hits"] >= 1, d
+    finally:
+        compile_cache.deconfigure()
+
+
+def test_aot_executable_runs_and_is_lru_cached():
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0}, fetches=["y"])
+    import jax.numpy as jnp
+
+    specs = {"x": ((tfs.scalar_type("float32")), (8, 2))}
+    fn = prog.aot_compile(specs)
+    out = fn({"x": jnp.ones((8, 2), jnp.float32)})
+    np.testing.assert_array_equal(np.asarray(out["y"]), np.full((8, 2), 2.0))
+    assert prog.aot_compile(specs) is fn  # memoized
+    assert isinstance(fn.fingerprint, str) and len(fn.fingerprint) == 16
+
+
+def test_pipeline_warmup_primes_cache(tmp_path):
+    import jax
+
+    assert compile_cache.configure(str(tmp_path / "cc"))
+    try:
+        rng = np.random.RandomState(23)
+        frame = tfs.TensorFrame.from_arrays(
+            {"x": rng.rand(64, 4).astype(np.float32)}, num_blocks=2
+        )
+        def chain():
+            return (
+                tfs.pipeline(frame)
+                .map_blocks(lambda x: {"g": x * 2.0}, trim=True)
+                .reduce_blocks(lambda g_input: {"g": g_input.sum(axis=0)})
+            )
+
+        chain().warmup()
+        jax.clear_caches()
+        c0 = obs.counters()
+        out = chain().run()
+        d = obs.counters_delta(c0)
+        assert d["persistent_cache_hits"] >= 1, d
+        np.testing.assert_allclose(
+            np.asarray(out["g"]),
+            np.asarray(frame.column("x").data).sum(axis=0) * 2.0,
+            rtol=1e-6,
+        )
+    finally:
+        compile_cache.deconfigure()
+
+
+# ---------------------------------------------------------------------------
+# Program.cached_jit LRU (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_cached_jit_is_lru_not_fifo():
+    prog = tfs.Program.wrap(lambda x: {"y": x}, fetches=["y"])
+    hot = prog.cached_jit(("hot",), lambda: lambda ins, params: ins)
+    # a burst of one-off keys larger than the cap must not evict a key
+    # that keeps getting hit
+    for i in range(2 * tfs.Program._DERIVED_CAP):
+        assert (
+            prog.cached_jit(("hot",), lambda: pytest.fail("hot rebuilt"))
+            is hot
+        )
+        prog.cached_jit(("one-off", i), lambda: lambda ins, params: ins)
+    assert (
+        prog.cached_jit(("hot",), lambda: pytest.fail("hot evicted")) is hot
+    )
+
+
+def test_warmup_mirrors_bucket_plan_for_cross_row_programs():
+    """Warmup must compile the sizes the verbs will RUN: a cross-row
+    program keeps exact per-size shapes, so warmup returns one
+    executable per distinct block size, not a dead bucketed one."""
+    frame = _uneven_frame(rows=101, blocks=2, d=4, seed=29)
+    prog = tfs.Program.wrap(
+        lambda x: {"y": x - x.mean(axis=0)}, fetches=["y"]
+    )
+    fps = tfs.warmup(prog, frame)
+    assert len(fps) == len(set(frame.block_sizes)) == 2
+    prog2 = tfs.Program.wrap(lambda x: {"y": x * 2.0}, fetches=["y"])
+    assert len(tfs.warmup(prog2, frame)) == 1  # row-independent: bucketed
+
+
+def test_warmup_probes_host_stage_cell_shape():
+    frame = tfs.TensorFrame.from_arrays(
+        {"x": np.arange(12, dtype=np.float32)}, num_blocks=2
+    )
+    fps = tfs.warmup(
+        lambda x: {"y": x.sum(axis=1)},
+        frame,
+        fetches=["y"],
+        host_stage={"x": lambda cells: np.stack([np.full(3, c) for c in cells])},
+    )
+    assert len(fps) >= 1  # staged cell shape (3,) probed from one row
+
+
+def test_malformed_ladder_warns_and_keeps_default(monkeypatch, caplog):
+    import logging
+
+    monkeypatch.setenv("TFS_BLOCK_BUCKETS", "1024;2048")
+    with caplog.at_level(logging.WARNING, "tensorframes_tpu.bucketing"):
+        assert bucketing.bucket_ladder() == ()  # default policy, not silence
+    assert any("1024;2048" in r.getMessage() for r in caplog.records)
+    monkeypatch.setenv("TFS_BLOCK_BUCKETS", "0,128")
+    assert bucketing.bucket_ladder() == ()  # not a silent disable
+    assert bucketing.enabled()
+
+
+def test_warmup_empty_frame_returns_nothing():
+    f = tfs.TensorFrame.from_arrays({"x": np.zeros((0, 4), np.float32)})
+    c0 = obs.counters()
+    assert tfs.warmup(lambda x: {"y": x + 1.0}, f, fetches=["y"]) == []
+    assert obs.counters_delta(c0)["backend_compiles"] == 0

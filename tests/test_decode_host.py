@@ -206,3 +206,27 @@ def test_decode_on_mesh_executor():
         _pixels(b).astype(np.float32).mean(axis=(0, 1)) for b in blobs
     ])
     np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-4)
+
+
+def test_mixed_sizes_error_names_offending_rows():
+    """Round-7 satellite: the mixed-size decode error names the offending
+    ROW indices (actionable for grouping by size), not just the size set."""
+    from tensorframes_tpu.graphdef.decode import pil_decoder
+
+    rng = np.random.RandomState(0)
+    big = _png(rng.randint(0, 255, (16, 16, 3), dtype=np.uint8))
+    small = _png(rng.randint(0, 255, (8, 8, 3), dtype=np.uint8))
+    dec = pil_decoder(3, "DecodePng")
+    with pytest.raises(ValueError) as ei:
+        dec([big, small, big, small, big])
+    msg = str(ei.value)
+    assert "rows 1, 3" in msg  # the minority rows, by index
+    assert "(16, 16, 3)" in msg  # the majority size named as reference
+    assert "block/bucket" in msg
+
+
+def test_mixed_sizes_error_elides_long_row_lists():
+    from tensorframes_tpu.graphdef.decode import _fmt_rows
+
+    assert _fmt_rows([0, 3, 7]) == "0, 3, 7"
+    assert _fmt_rows(list(range(12))) == "0, 1, 2, 3, 4, 5, 6, 7, … (+4 more)"

@@ -1,0 +1,137 @@
+"""Empty-frame contract (round 7 satellite): explicit, tested semantics
+for 0-row frames across ``repartition`` and all six verbs, replacing
+"whatever the engine happens to do" (``frame.py`` previously built one
+block via ``min(num_blocks, n) or 1`` and aggregate crashed in numpy).
+
+The contract (documented on ``TensorFrame.repartition``):
+
+* an empty frame always has exactly ONE empty block;
+* non-trimmed map verbs return an empty frame with the program's
+  inferred output schema — no trace, no compile;
+* a trimmed map applies the program to the empty block (its output row
+  count is program-defined);
+* ``reduce_rows`` / ``reduce_blocks`` raise ``ValidationError`` (no
+  identity element for an arbitrary program);
+* ``aggregate`` returns an empty result frame (zero groups), contract
+  still validated."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import ValidationError
+from tensorframes_tpu.observability import counters, counters_delta
+
+
+def _empty_frame():
+    return tfs.TensorFrame.from_arrays(
+        {
+            "x": np.zeros((0, 3), np.float32),
+            "k": np.zeros((0,), np.int32),
+        }
+    )
+
+
+def test_repartition_empty_always_one_block():
+    f = _empty_frame()
+    for nb in (1, 2, 7):
+        r = f.repartition(nb)
+        assert r.num_rows == 0
+        assert r.num_blocks == 1
+        assert r.offsets == (0, 0)
+    with pytest.raises(tfs.SchemaError, match="num_blocks"):
+        f.repartition(0)
+
+
+def test_map_blocks_empty_no_compile():
+    f = _empty_frame()
+    c0 = counters()
+    out = tfs.map_blocks(lambda x: {"y": x * 2.0 + 1.0}, f)
+    d = counters_delta(c0)
+    assert d["program_traces"] == 0 and d["backend_compiles"] == 0, d
+    assert out.num_rows == 0
+    assert set(out.column_names) == {"y", "x", "k"}  # outputs + passthrough
+    y = out.column("y")
+    assert np.asarray(y.data).shape == (0, 3)
+    assert np.asarray(y.data).dtype == np.float32
+
+
+def test_map_rows_empty_no_compile():
+    f = _empty_frame()
+    c0 = counters()
+    out = tfs.map_rows(lambda x: {"s": x.sum()}, f)
+    d = counters_delta(c0)
+    assert d["program_traces"] == 0 and d["backend_compiles"] == 0, d
+    assert out.num_rows == 0
+    assert np.asarray(out.column("s").data).shape == (0,)
+
+
+def test_map_blocks_trimmed_empty_applies_program():
+    # the trimmed contract: the program runs on the empty block and its
+    # outputs ARE the result (here: one all-zero sum row per block)
+    f = _empty_frame()
+    out = tfs.map_blocks_trimmed(
+        lambda x: {"m": x.sum(axis=0, keepdims=True)}, f
+    )
+    assert out.num_rows == 1
+    np.testing.assert_array_equal(
+        np.asarray(out.column("m").data), np.zeros((1, 3), np.float32)
+    )
+
+
+def test_reduce_verbs_empty_raise():
+    f = _empty_frame()
+    with pytest.raises(ValidationError, match="empty"):
+        tfs.reduce_rows(lambda x_1, x_2: {"x": x_1 + x_2}, f)
+    with pytest.raises(ValidationError, match="empty"):
+        tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(axis=0)}, f)
+
+
+def test_aggregate_empty_returns_empty_groups():
+    f = _empty_frame()
+    out = tfs.aggregate(
+        lambda x_input: {"x": x_input.sum(axis=0)}, f.group_by("k")
+    )
+    assert out.num_rows == 0
+    assert out.column_names == ["k", "x"]
+    assert np.asarray(out.column("k").data).dtype == np.int32
+    assert np.asarray(out.column("x").data).shape == (0, 3)
+
+
+def test_aggregate_empty_still_validates_contract():
+    f = _empty_frame()
+    # a non-reducing program must fail the same way it does on data
+    with pytest.raises(ValidationError):
+        tfs.aggregate(lambda x_input: {"x": x_input * 2.0}, f.group_by("k"))
+
+
+def test_map_empty_row_count_contract_still_enforced():
+    # a row-count-changing program without trim is rejected on empty
+    # frames too (inference catches it; parity with the non-empty path)
+    f = _empty_frame()
+    with pytest.raises(ValidationError, match="row count"):
+        tfs.map_blocks(lambda x: {"m": x.sum(axis=0, keepdims=True)}, f)
+
+
+def test_map_empty_shape_hints_respected():
+    f = _empty_frame()
+    out = tfs.map_blocks(
+        lambda x: {"y": x + 1.0}, f, shapes={"y": [-1, 3]}
+    )
+    assert np.asarray(out.column("y").data).shape == (0, 3)
+
+
+def test_map_empty_host_stage_sees_real_empty_slice():
+    """The stage fn receives the column's true (0, *cell) slice, so a
+    shape-preserving stage infers the same output schema as on data."""
+    seen = {}
+
+    def stage(value):
+        arr = np.asarray(value, dtype=np.float32)
+        seen["shape"] = arr.shape
+        return arr * 2.0
+
+    f = tfs.TensorFrame.from_arrays({"x": np.zeros((0, 32), np.float32)})
+    out = tfs.map_blocks(lambda x: {"y": x + 1.0}, f, host_stage={"x": stage})
+    assert seen["shape"] == (0, 32)
+    assert np.asarray(out.column("y").data).shape == (0, 32)

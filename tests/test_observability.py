@@ -109,3 +109,45 @@ def test_profile_dir_writes_trace(tmp_path):
     for root, _, files in os.walk(tmp_path / "prof"):
         dumped.extend(files)
     assert dumped, "jax.profiler trace produced no files"
+
+
+# ---------------------------------------------------------------------------
+# retrace counters (round 7)
+# ---------------------------------------------------------------------------
+
+
+def test_counters_count_program_traces_per_verb():
+    c0 = observability.counters()
+    tfs.map_blocks(lambda x: {"z": x + 2.0}, _frame())
+    d = observability.counters_delta(c0)
+    assert d["program_traces"] >= 1
+    by_verb = observability.counters()["by_verb"]
+    assert by_verb["map_blocks"]["program_traces"] >= 1
+
+
+def test_counters_repeat_call_adds_no_traces():
+    frame = _frame()
+    prog = tfs.Program.wrap(lambda x: {"z": x * 2.0}, fetches=["z"])
+    tfs.map_blocks(prog, frame)
+    c0 = observability.counters()
+    tfs.map_blocks(prog, frame)  # same Program, same shapes: cache hit
+    d = observability.counters_delta(c0)
+    assert d["program_traces"] == 0, d
+    assert d["backend_compiles"] == 0, d
+
+
+def test_analysis_tracing_is_suppressed():
+    prog = tfs.Program.wrap(lambda x: {"z": x + 1.0}, fetches=["z"])
+    c0 = observability.counters()
+    prog.analyze({"x": (tfs.scalar_type("float64"), (-1,))})
+    d = observability.counters_delta(c0)
+    assert d["program_traces"] == 0, d
+
+
+def test_enabled_spans_carry_retrace_delta():
+    observability.enable()
+    tfs.map_blocks(lambda x: {"z": x - 1.0}, _frame())
+    span = observability.last_spans()[-1]
+    assert "retrace" in span
+    assert span["retrace"]["program_traces"] >= 1
+    assert "backend_compiles" in span["retrace"]

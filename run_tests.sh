@@ -8,5 +8,17 @@ cd "$(dirname "$0")"
 echo "== building native extension (optional) =="
 python -m tensorframes_tpu.native.build || echo "native build failed; numpy fallback will be used"
 
+# Device-pool tier: the block-parallel scheduler's tests run against an
+# explicitly forced 8-device host (conftest re-isolates each test_pooled_*
+# into its own interpreter on top of this, so per-device jit caches never
+# leak between tests or into the main suite below).  No "$@" here — a
+# caller's -k/path filter applies to the main suite only (a non-matching
+# filter would exit 5 and kill the script under `set -e`); the main run
+# ignores the pool file so the expensive isolated tests run exactly once.
+echo "== device-pool tier (forced 8 host devices) =="
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_device_pool.py -q
+
 echo "== pytest =="
-exec python -m pytest tests/ -q "$@"
+exec python -m pytest tests/ -q --ignore=tests/test_device_pool.py "$@"

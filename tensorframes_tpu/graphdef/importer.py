@@ -134,7 +134,29 @@ def _eval_function(graph: GraphDef, fname: str, args, depth: int):
                     f"function {fname!r}: {node_op} has no output arg "
                     f"{out_arg!r} (ref {ref!r})"
                 )
-            flat = names.index(out_arg)
+            # flat tuple position = the named arg's slot plus the index
+            # WITHIN that arg: every op in _OUTPUT_ARGS today has
+            # single-tensor output args (idx always 0), but a future
+            # number_attr-sized output arg must not silently alias the
+            # arg's slot 0 (advisor, round 5).  The base is exact only
+            # while the PRECEDING args are single tensors, so indexing
+            # into a non-final arg is refused rather than mis-resolved.
+            if idx != 0 and out_arg != names[-1]:
+                raise GraphImportError(
+                    f"function {fname!r}: ref {ref!r} indexes into "
+                    f"output arg {out_arg!r} of {node_op}, which "
+                    f"precedes other output args; flat positions after "
+                    f"a sized arg are unknown — extend _OUTPUT_ARGS "
+                    f"with per-arg sizes to support this op"
+                )
+            # Remaining limitation, by construction: names.index assumes
+            # every arg BEFORE out_arg is a single tensor, so a sized
+            # NON-final arg would shift later names' bases undetectably
+            # (len(val) vs len(names) cannot say WHICH arg grew).  No op
+            # in the table has one today; adding one requires per-arg
+            # sizes here, and the guard above already refuses the
+            # detectable inner-index form.
+            flat = names.index(out_arg) + idx
         else:
             flat = idx  # single output arg (possibly number_attr-sized)
         if isinstance(val, tuple):
@@ -361,6 +383,61 @@ def import_graphdef(
         fn = decode_mod.pil_decoder(channels, n.op)
         fn._tfs_channels = int(channels)
         host_prelude[src] = fn
+    # A placeholder that feeds a Decode* prelude is re-fed DECODED uint8
+    # pixels at run time, so any OTHER reachable consumer of its bytes —
+    # beyond the Identity/Snapshot forwarding chain into the decoders —
+    # would silently read pixels where the graph says encoded bytes.
+    # Reject, naming both consumers (advisor, round 5).
+    if host_prelude:
+        byte_chain: Dict[str, str] = {ph: ph for ph in host_prelude}
+        changed = True
+        while changed:  # resolve Identity/Snapshot chains to fixpoint
+            changed = False
+            for n in graph.nodes:
+                if (
+                    n.name in reachable
+                    and n.name not in byte_chain
+                    and n.op in ("Identity", "Snapshot")
+                    and n.inputs
+                ):
+                    src, _ = _split_ref(n.inputs[0])
+                    if src in byte_chain:
+                        byte_chain[n.name] = byte_chain[src]
+                        changed = True
+        for n in graph.nodes:
+            if (
+                n.name not in reachable
+                or n.op in decode_mod.DECODE_OPS
+                or n.name in byte_chain  # the forwarding chain itself
+            ):
+                continue
+            for ref in n.inputs:
+                rn, ri = _split_ref(ref)
+                if ri == -1 or rn not in byte_chain:
+                    continue
+                ph = byte_chain[rn]
+                decs = sorted(d for d, s in decode_src.items() if s == ph)
+                raise GraphImportError(
+                    f"placeholder {ph!r} feeds both a decode host prelude "
+                    f"({', '.join(decs)}) and non-decode consumer "
+                    f"{n.name!r} ({n.op}); the prelude replaces the fed "
+                    f"bytes with decoded uint8 pixels, so {n.name!r} would "
+                    f"silently receive pixels instead of the encoded "
+                    f"bytes. Feed that consumer from its own placeholder, "
+                    f"or decode explicitly via host_stage."
+                )
+        for out, name, _ in fetch_list:
+            if name in byte_chain:
+                ph = byte_chain[name]
+                decs = sorted(d for d, s in decode_src.items() if s == ph)
+                raise GraphImportError(
+                    f"fetch {out!r} reads placeholder {ph!r}, which feeds "
+                    f"a decode host prelude ({', '.join(decs)}); the "
+                    f"prelude replaces the fed bytes with decoded uint8 "
+                    f"pixels, so the fetch would silently return pixels. "
+                    f"Fetch the decode node instead, or feed the bytes "
+                    f"through their own placeholder."
+                )
     feed = dict(inputs or {})
     for k in feed:
         if k not in input_names:

@@ -61,6 +61,7 @@ _counters: Dict[str, int] = {
     "backend_compiles": 0,
     "persistent_cache_hits": 0,
     "persistent_cache_misses": 0,
+    "pool_blocks": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
 
@@ -94,6 +95,13 @@ def note_program_trace() -> None:
         return
     _counters["program_traces"] += 1
     _verb_bump("program_traces")
+
+
+def note_pool_dispatch() -> None:
+    """Called by the device-pool scheduler (``ops/device_pool.py``) once
+    per block dispatched through the pool — the always-on counter that
+    lets a bench record prove pool utilisation rather than assert it."""
+    _counters["pool_blocks"] += 1
 
 
 @contextlib.contextmanager
@@ -165,6 +173,7 @@ def counters_delta(
             "backend_compiles",
             "persistent_cache_hits",
             "persistent_cache_misses",
+            "pool_blocks",
         )
     }
 

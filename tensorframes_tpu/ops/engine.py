@@ -24,8 +24,13 @@ The mapping, per SURVEY.md §2.7:
   host group-index build + size-bucketed ``vmap`` of the block program over
   all groups of equal cardinality (no buffer-size-10 compaction artifact).
 
-The ``Executor`` here is single-device; ``tensorframes_tpu.parallel`` provides
-the mesh/``shard_map`` executor with collective cross-shard reduction.
+The ``Executor`` here is single-PROGRAM; on a multi-chip host the
+device-pool scheduler (``ops/device_pool.py``, ``TFS_DEVICE_POOL``)
+spreads a host-fresh frame's independent blocks across all local devices
+— the reference's per-partition data parallelism (SURVEY P1/P4) at
+single-host scale, bit-identical to the serial path.
+``tensorframes_tpu.parallel`` provides the mesh/``shard_map`` executor
+with collective cross-shard reduction for the GSPMD form.
 """
 
 from __future__ import annotations
@@ -43,7 +48,7 @@ from ..frame import Column, TensorFrame
 from ..program import Program
 from ..schema import ColumnInfo, Schema
 from ..shape import Shape, ShapeError, UNKNOWN
-from . import bucketing, prefetch, segment_compile, validation
+from . import bucketing, device_pool, prefetch, segment_compile, validation
 from .validation import ValidationError
 
 
@@ -125,11 +130,22 @@ class Executor:
     on device (``jax.Array`` columns).  The only host syncs are the user's own
     materialisation calls (``collect``/``to_arrays``/``np.asarray``) and the
     single-cell results of the reduce verbs.
+
+    Exception, by design: when the device POOL engages (``TFS_DEVICE_POOL``,
+    host-fresh multi-block frame, >=2 local devices) the map verbs return
+    host-assembled columns — per-block D2H starts as each block completes
+    (overlapped with later blocks' compute) and the verb syncs on the last
+    block.  See ``ops/device_pool.py`` for the scope rules.
     """
 
     # monoid aggregates may run as one device segment reduction; the mesh
     # executor shards the same path over its data axis via _place_rows
     supports_segment_aggregate = True
+
+    # host-fresh multi-block frames may dispatch blocks across ALL local
+    # devices (ops/device_pool.py, TFS_DEVICE_POOL); the mesh executor
+    # opts out — its GSPMD sharding is its own multi-device story
+    supports_device_pool = True
 
     def _place_rows(self, arr: jnp.ndarray) -> jnp.ndarray:
         """Device placement for a row-axis array in the segment-aggregate
@@ -148,21 +164,25 @@ class Executor:
 
     # ---------------------------------------------------------------- map --
 
-    def _device_value(self, value: Any, st) -> jnp.ndarray:
+    def _device_value(self, value: Any, st, device=None) -> jnp.ndarray:
         """One block/column of data -> device array in its compute dtype.
 
         Device-resident values (chained verb outputs) are used in place —
         at most a device-side cast; host values are cast on host then moved
         with an async ``device_put`` (the single-copy replacement for
-        ``datatypes.scala:93-127``)."""
+        ``datatypes.scala:93-127``).  ``device``: explicit placement for
+        the device-pool scheduler's per-device staging lanes (None keeps
+        jax's default device)."""
         if isinstance(value, jax.Array):
             if value.dtype != st.np_dtype:
                 value = value.astype(st.np_dtype)
+            if device is not None:
+                value = jax.device_put(value, device)
             return value
         arr = np.asarray(value)
         if arr.dtype != st.np_dtype:
             arr = arr.astype(st.np_dtype)
-        return jax.device_put(arr)
+        return jax.device_put(arr, device)
 
     def _staged_value(self, stage_fn, value, input_name: str) -> np.ndarray:
         """Run one host_stage fn over a block's cells and shape-check the
@@ -193,13 +213,15 @@ class Executor:
         infos: Mapping[str, ColumnInfo],
         host_stage: Optional[Mapping[str, Any]] = None,
         pad_to: Optional[int] = None,
+        device=None,
     ) -> Dict[str, jnp.ndarray]:
         """``pad_to``: bucket target for the block's row axis (shape-
         canonical execution).  Host blocks pad in numpy *before* the
         ``device_put``, so the staged transfer already carries the padded
         signature (prefetch worker included); device-resident blocks pad
         with a device-side concat on the consumer thread.  Callers slice
-        the outputs back to the true row count."""
+        the outputs back to the true row count.  ``device``: explicit
+        target for the device-pool staging lanes."""
         inputs = {}
         for n in program.input_names:
             value = block[program.column_for_input(n)]
@@ -210,7 +232,7 @@ class Executor:
                 st = dtypes.coerce(infos[n].scalar_type)
             if pad_to is not None and not isinstance(value, jax.Array):
                 value = bucketing.pad_rows(np.asarray(value), pad_to)
-            value = self._device_value(value, st)
+            value = self._device_value(value, st, device=device)
             if pad_to is not None and isinstance(value, jax.Array):
                 value = bucketing.pad_rows(value, pad_to)
             inputs[n] = value
@@ -316,9 +338,12 @@ class Executor:
         per: int,
         rows_level: bool = False,
         pf_stats: Optional[Dict[str, Any]] = None,
+        device=None,
     ) -> Dict[str, Any]:
         """Chunked h2d + dispatch: equal row slices (last may be short, so
         at most two executables trace), outputs concatenated on device.
+        ``device``: chunk staging target under the device-pool scheduler
+        (the whole block's chunks stream to the block's assigned device).
 
         The chunks run through a :class:`prefetch.Prefetcher`: chunk k+1's
         cast + ``device_put`` happen on the staging thread while chunk k's
@@ -353,7 +378,7 @@ class Executor:
                 }
             return {
                 nm: self._device_value(
-                    v, dtypes.coerce(infos[nm].scalar_type)
+                    v, dtypes.coerce(infos[nm].scalar_type), device=device
                 )
                 for nm, v in staged.items()
             }
@@ -473,8 +498,19 @@ class Executor:
         (output shapes are static, so row-count validation needs no data).
         ``host_stage``: input name -> host fn(cells) -> [rows, *cell] array,
         run per block before the device program (binary decode, bucketing);
-        it executes on the prefetch staging thread, so block N+1's host
-        stage AND h2d transfer overlap block N's device compute."""
+        it executes on ONE prefetch staging thread in block order — under
+        the device pool too, where only h2d/compute/readback parallelize —
+        so block N+1's host stage AND h2d transfer overlap block N's
+        device compute.
+
+        Device pool (``TFS_DEVICE_POOL``, host-fresh multi-block frames on
+        a >=2-device host): blocks dispatch across all local devices and
+        the verb returns HOST-assembled output columns — each block's D2H
+        copy starts as it completes, overlapping later blocks' compute,
+        and the verb synchronizes on the last block (the trade the pool
+        makes: cross-device parallelism for device residency, so a
+        chained verb re-stages its inputs).  The serial single-device
+        path keeps the fully async, device-resident contract."""
         host_stage = _with_prelude(program, host_stage)
         with observability.verb_span(
             "map_blocks", frame.num_rows, frame.num_blocks
@@ -542,6 +578,22 @@ class Executor:
         )
         donate = prefetch.donate_inputs()
         fresh = self._frame_fresh(frame)
+        # device-pool scheduler (ops/device_pool.py): a host-fresh multi-
+        # block frame spreads its independent blocks across all local
+        # devices — per-device staging lanes, async dispatch, overlapped
+        # readback.  Device-resident frames stay serial on their device
+        # (splitting a cached column across the pool would shuffle HBM),
+        # and the mesh executor opts out (supports_device_pool).
+        pool_devs = (
+            device_pool.pool_devices()
+            if (self.supports_device_pool and fresh and frame.num_blocks > 1)
+            else []
+        )
+        if len(pool_devs) >= 2:
+            return self._map_dispatch_pool(
+                program, frame, infos, host_stage, span, rows_level, trim,
+                plans, pads, donate, pool_devs,
+            )
         # only spin up a staging thread when some block will actually
         # stage on it; otherwise (device-resident frame, or every block
         # streamed at chunk level) keep the plain consumer loop
@@ -592,28 +644,7 @@ class Executor:
                     # (row-independence guarantees real rows' values are
                     # bit-identical to the exact-shape path)
                     outs = {k: v[:n_rows] for k, v in outs.items()}
-            if rows_level:
-                pass  # row programs are per-cell; no block row-count check
-            elif not trim:
-                for name, v in outs.items():
-                    if v.ndim == 0 or v.shape[0] != n_rows:
-                        raise ValidationError(
-                            f"map_blocks: output {name!r} has shape "
-                            f"{v.shape} but the input block has {n_rows} "
-                            f"rows; a non-trimmed map must preserve the "
-                            f"row count (use map_blocks_trimmed to "
-                            f"change it)."
-                        )
-            else:
-                counts = {
-                    v.shape[0] if v.ndim else None for v in outs.values()
-                }
-                if len(counts) != 1 or None in counts:
-                    raise ValidationError(
-                        f"map_blocks_trimmed: outputs disagree on row "
-                        f"count: { {k: v.shape for k, v in outs.items()} }"
-                    )
-            _check_shape_hints(program, outs, verb, cell_level=rows_level)
+            self._check_block_outputs(program, outs, n_rows, rows_level, trim)
             out_blocks.append(outs)
         # the loop consumed every item, so the staging thread has finished
         # (its last stats write happened-before the last queue get): pf.stats
@@ -639,6 +670,158 @@ class Executor:
                 # whether donation actually applied to this verb's blocks,
                 # not just the knob: a device-resident frame never donates
                 "donate": donate and fresh,
+            },
+        )
+        return out_blocks
+
+    def _check_block_outputs(
+        self, program: Program, outs, n_rows: int, rows_level: bool,
+        trim: bool,
+    ) -> None:
+        """Per-block output validation shared by the serial and pooled
+        dispatch loops: the non-trimmed row-count contract, the trimmed
+        agreement contract, and the shape-hint check."""
+        verb = "map_rows" if rows_level else "map_blocks"
+        if rows_level:
+            pass  # row programs are per-cell; no block row-count check
+        elif not trim:
+            for name, v in outs.items():
+                if v.ndim == 0 or v.shape[0] != n_rows:
+                    raise ValidationError(
+                        f"map_blocks: output {name!r} has shape "
+                        f"{v.shape} but the input block has {n_rows} "
+                        f"rows; a non-trimmed map must preserve the "
+                        f"row count (use map_blocks_trimmed to "
+                        f"change it)."
+                    )
+        else:
+            counts = {
+                v.shape[0] if v.ndim else None for v in outs.values()
+            }
+            if len(counts) != 1 or None in counts:
+                raise ValidationError(
+                    f"map_blocks_trimmed: outputs disagree on row "
+                    f"count: { {k: v.shape for k, v in outs.items()} }"
+                )
+        _check_shape_hints(program, outs, verb, cell_level=rows_level)
+
+    def _map_dispatch_pool(
+        self,
+        program: Program,
+        frame: TensorFrame,
+        infos,
+        host_stage,
+        span,
+        rows_level: bool,
+        trim: bool,
+        plans: Sequence[Optional[int]],
+        pads: Sequence[Optional[int]],
+        donate: bool,
+        devices: Sequence[Any],
+    ) -> List[Dict[str, Any]]:
+        """Device-pool edition of the map-verb block loop: blocks dispatch
+        round-robin/least-loaded across ``devices`` with per-device
+        staging lanes and a bounded in-flight readback window per device
+        (``ops/device_pool.py``).
+
+        Each lane's worker stages its device's next blocks (host cast +
+        ``host_stage`` + bucket pad + async ``device_put`` TO that
+        device) while the consumer thread dispatches in global block
+        order — dispatch is async, so device k computes block N while the
+        consumer hands block N+1 to device k+1 and lane k stages block
+        N+2.  Completed blocks start their D2H copy immediately and are
+        materialised at most ``depth`` blocks behind dispatch, so output
+        assembly overlaps later blocks' compute.  Outputs land in
+        ``out_blocks[bi]`` (host numpy) strictly by block index — the
+        pooled result is bit-identical to the serial path, reassembled in
+        block order no matter which device finishes first.  Only called
+        for host-FRESH frames, so the donation rules carry over
+        unchanged: every staged buffer is fresh by construction (donate
+        when the backend supports it), and no shared device-resident
+        column can reach a donating executable.  Streamed blocks
+        (``plans``) keep chunk-granular staging, pointed at their
+        assigned device."""
+        sizes = frame.block_sizes
+        nb = frame.num_blocks
+        assignment = device_pool.assign(sizes, len(devices))
+        depth = prefetch.prefetch_depth()
+        pool = device_pool.PoolRun(devices, assignment, depth or 1)
+
+        def stage_block(bi, dev):
+            if plans[bi] is not None:
+                return None  # streamed inline, chunk-level staging below
+            return self._device_inputs(
+                program, frame.block(bi), infos, host_stage,
+                pad_to=pads[bi], device=dev,
+            )
+
+        if host_stage:
+            # the host_stage contract predates the pool: stage fns run on
+            # ONE staging thread in strict block order (they may be
+            # stateful or non-reentrant).  Pooling keeps that contract —
+            # a single lane stages every block in order, device_put
+            # pointed at each block's assigned device; compute dispatch
+            # and readback still parallelize across the pool.
+            single = prefetch.Prefetcher(
+                lambda bi: stage_block(bi, devices[assignment[bi]]),
+                nb,
+                name="tfs-pool-stage",
+            )
+            lanes = [single]
+            lane_iters = None
+            single_iter = iter(single)
+        else:
+            lanes = device_pool.lanes(devices, assignment, stage_block)
+            lane_iters = [iter(l) for l in lanes]
+            single_iter = None
+        chunk_stats = {"items": 0, "stage_s": 0.0, "wait_s": 0.0}
+        out_blocks: List[Optional[Dict[str, Any]]] = [None] * nb
+        for bi in range(nb):
+            di = assignment[bi]
+            staged = (
+                next(single_iter)
+                if single_iter is not None
+                else next(lane_iters[di])
+            )
+            n_rows = sizes[bi]
+            if plans[bi] is not None:
+                outs = self._run_block_streamed(
+                    program, frame.block(bi), infos, plans[bi],
+                    rows_level=rows_level, pf_stats=chunk_stats,
+                    device=devices[di],
+                )
+            else:
+                if rows_level:
+                    outs = self._rows_run(program, donate)(staged)
+                elif donate:
+                    outs = self._block_run(program, True)(staged)
+                else:
+                    outs = self._run_block_program(program, staged)
+                del staged  # drop staged refs (donation hygiene)
+                if pads[bi] is not None:
+                    outs = {k: v[:n_rows] for k, v in outs.items()}
+            self._check_block_outputs(program, outs, n_rows, rows_level, trim)
+            pool.submit(bi, di, n_rows, outs, out_blocks)
+        pool.finish(out_blocks)
+        staged_blocks = sum(1 for p in plans if p is None)
+        stage_s = (
+            sum(l.stats["stage_s"] for l in lanes) + chunk_stats["stage_s"]
+        )
+        wait_s = (
+            sum(l.stats["wait_s"] for l in lanes) + chunk_stats["wait_s"]
+        )
+        span.annotate("device_pool", pool.record(stage_s, wait_s))
+        span.annotate(
+            "prefetch",
+            {
+                "items": staged_blocks + chunk_stats["items"],
+                "depth": prefetch.prefetch_depth(),
+                "stage_s": round(stage_s, 6),
+                "wait_s": round(wait_s, 6),
+                "overlap_ratio": round(
+                    prefetch.overlap_ratio(stage_s, wait_s), 4
+                ),
+                "donate": donate,
             },
         )
         return out_blocks
@@ -1043,6 +1226,44 @@ class Executor:
                 raw, specs, ("aot", bool(rows_level), donate)
             )
             fps.append(fn.fingerprint)
+        # device-pool priming: when the pool would engage for this frame,
+        # execute the SAME entry the dispatch loop uses once per (bucketed
+        # size, device) on zero-filled blocks, so the first real dispatch
+        # on EVERY pool device is a jit-cache hit (backed by the
+        # persistent cache: the per-device compile is a disk fetch in a
+        # warmed process).  Execution, not just lowering: jax keys
+        # executables by input placement, and running the entry on the
+        # target device is the one way to seed that key.  Programs are
+        # pure by contract, so a zeros dispatch has no effect beyond the
+        # caches; trace counting is suppressed (warmup is analysis).
+        pool_devs = (
+            device_pool.pool_devices()
+            if (
+                self.supports_device_pool
+                and self._frame_fresh(frame)
+                and frame.num_blocks > 1
+            )
+            else []
+        )
+        if len(pool_devs) >= 2:
+            for n_rows in exec_sizes:
+                zeros = {}
+                for n in program.input_names:
+                    if n in staged_specs:
+                        st, cell = staged_specs[n]
+                    else:
+                        st = dtypes.coerce(infos[n].scalar_type)
+                        cell = tuple(infos[n].cell_shape)
+                    zeros[n] = np.zeros(
+                        (n_rows,) + tuple(cell), st.np_dtype
+                    )
+                for dev in pool_devs:
+                    inputs = {
+                        k: jax.device_put(v, dev) for k, v in zeros.items()
+                    }
+                    with observability.suppress_trace_count():
+                        out = run(inputs)
+                    jax.block_until_ready(out)
         return fps
 
     def _column_array(
@@ -1181,18 +1402,9 @@ class Executor:
         ) as span:
             bases, reduced, run = self._reduce_rows_setup(program, frame, mode)
             span.mark("validate")
-            partials: List[Dict[str, jnp.ndarray]] = []
-            for bi in range(frame.num_blocks):
-                if frame.block_sizes[bi] == 0:
-                    continue  # empty-partition guard (DebugRowOps:489-499)
-                block = frame.block(bi)
-                arrays = {
-                    b: self._device_value(
-                        block[b], dtypes.coerce(reduced[b].scalar_type)
-                    )
-                    for b in bases
-                }
-                partials.append(run(arrays))
+            # empty-partition guard inside (DebugRowOps:489-499); pooled
+            # across local devices for host-fresh multi-block frames
+            partials = self._reduce_partials(run, bases, reduced, frame, span)
             if len(partials) == 1:
                 final = partials[0]
             else:
@@ -1204,6 +1416,81 @@ class Executor:
             out = {b: _np(final[b]) for b in bases}
             span.mark("sync")
             return out
+
+    def _reduce_partials(
+        self, run, bases, reduced, frame: TensorFrame, span
+    ) -> List[Dict[str, jnp.ndarray]]:
+        """Per-block partials for the reduce verbs (empty blocks skipped),
+        device-pooled when the pool engages.
+
+        Pooled: each nonempty block's input arrays stage to its assigned
+        device on a per-device lane and ``run`` folds the block THERE —
+        the device-granularity analog of the reference's per-partition
+        reduce (SURVEY P1/P4).  Every partial then moves (async, one cell
+        per base column) to ONE combine device, in block order, so the
+        caller's final combine is byte-for-byte the single-device fold —
+        same stack, same fold shape, bit-identical results regardless of
+        completion order.  (A per-device local pre-fold would be one
+        combine cheaper but would change the fold shape; bit-identity
+        wins.)"""
+        sizes = frame.block_sizes
+        nonempty = [bi for bi in range(frame.num_blocks) if sizes[bi] > 0]
+        sts = {b: dtypes.coerce(reduced[b].scalar_type) for b in bases}
+        pool_devs = (
+            device_pool.pool_devices()
+            if (
+                self.supports_device_pool
+                and len(nonempty) > 1
+                and self._frame_fresh(frame)
+            )
+            else []
+        )
+        if len(pool_devs) < 2:
+            partials: List[Dict[str, jnp.ndarray]] = []
+            for bi in nonempty:
+                block = frame.block(bi)
+                arrays = {
+                    b: self._device_value(block[b], sts[b]) for b in bases
+                }
+                partials.append(run(arrays))
+            span.mark("dispatch_partials")
+            return partials
+        assignment = device_pool.assign(
+            [sizes[bi] for bi in nonempty], len(pool_devs)
+        )
+        pool = device_pool.PoolRun(
+            pool_devs, assignment, prefetch.prefetch_depth() or 1
+        )
+
+        def stage_block(k, dev):
+            block = frame.block(nonempty[k])
+            return {
+                b: self._device_value(block[b], sts[b], device=dev)
+                for b in bases
+            }
+
+        lanes = device_pool.lanes(pool_devs, assignment, stage_block)
+        lane_iters = [iter(l) for l in lanes]
+        combine = pool_devs[0]
+        partials = []
+        for k, bi in enumerate(nonempty):
+            di = assignment[k]
+            arrays = next(lane_iters[di])
+            p = run(arrays)
+            pool.note_dispatch(di, sizes[bi])
+            # async hop to the combine device: one reduced cell per base
+            partials.append(
+                {b: jax.device_put(p[b], combine) for b in bases}
+            )
+        span.annotate(
+            "device_pool",
+            pool.record(
+                sum(l.stats["stage_s"] for l in lanes),
+                sum(l.stats["wait_s"] for l in lanes),
+            ),
+        )
+        span.mark("dispatch_partials")
+        return partials
 
     def _reduce_blocks_setup(
         self, program: Program, frame: TensorFrame, verb: str = "reduce_blocks"
@@ -1251,18 +1538,9 @@ class Executor:
         ) as span:
             bases, reduced, run = self._reduce_blocks_setup(program, frame)
             span.mark("validate")
-            partials: List[Dict[str, jnp.ndarray]] = []
-            for bi in range(frame.num_blocks):
-                if frame.block_sizes[bi] == 0:
-                    continue  # empty-partition guard (DebugRowOps:512-522)
-                block = frame.block(bi)
-                arrays = {
-                    b: self._device_value(
-                        block[b], dtypes.coerce(reduced[b].scalar_type)
-                    )
-                    for b in bases
-                }
-                partials.append(run(arrays))
+            # empty-partition guard inside (DebugRowOps:512-522); pooled
+            # across local devices for host-fresh multi-block frames
+            partials = self._reduce_partials(run, bases, reduced, frame, span)
             if len(partials) == 1:
                 final = partials[0]
             else:

@@ -65,7 +65,7 @@ from ..frame import TensorFrame, is_device_array
 from ..program import Program
 from ..schema import ColumnInfo, Schema
 from ..shape import Shape, UNKNOWN
-from . import prefetch, validation
+from . import bucketing, device_pool, prefetch, segment_compile, validation
 from .engine import _DEFAULT
 from .validation import ValidationError
 
@@ -138,6 +138,11 @@ class Pipeline:
         # buffers per call and may donate them; a cached frame must not
         self._compiled: Dict[bool, Any] = {}
         self._iter_compiled: Dict[Any, Any] = {}
+        # device-pool per-block executable (map-terminal chains), keyed
+        # by donate flag like _compiled; _pool_proofs memoizes the
+        # chain-level row-independence proofs bucket padding is gated on
+        self._pool_compiled: Dict[bool, Any] = {}
+        self._pool_proofs: Dict[Any, bool] = {}
 
     # ------------------------------------------------------------ builders --
 
@@ -426,65 +431,10 @@ class Pipeline:
 
         row: Optional[Dict[str, Any]] = None
         for st, params in zip(self._stages, params_list):
-            if st.kind == "map_blocks":
-                new_blocks = []
-                for blk in blocks:
-                    n_rows = len(next(iter(blk.values())))
-                    inputs = {
-                        n: blk[st.program.column_for_input(n)]
-                        for n in st.program.input_names
-                    }
-                    outs = st.program.call(inputs, params)
-                    if not st.trim:
-                        for name, v in outs.items():
-                            if v.ndim == 0 or v.shape[0] != n_rows:
-                                raise ValidationError(
-                                    f"pipeline.map_blocks: output {name!r} "
-                                    f"has shape {v.shape} but the block has "
-                                    f"{n_rows} rows; use trim=True to change "
-                                    f"the row count."
-                                )
-                        nb = {
-                            **{
-                                k: v for k, v in blk.items() if k not in outs
-                            },
-                            **outs,
-                        }
-                    else:
-                        counts = {
-                            v.shape[0] if v.ndim else None
-                            for v in outs.values()
-                        }
-                        if len(counts) != 1 or None in counts:
-                            raise ValidationError(
-                                f"pipeline.map_blocks_trimmed: outputs "
-                                f"disagree on row count: "
-                                f"{ {k: v.shape for k, v in outs.items()} }"
-                            )
-                        nb = dict(outs)
-                    new_blocks.append(nb)
-                blocks = new_blocks
-            elif st.kind == "map_rows":
-                program = st.program
-                new_blocks = []
-                for blk in blocks:
-                    inputs = {
-                        n: blk[program.column_for_input(n)]
-                        for n in program.input_names
-                    }
-                    outs = jax.vmap(
-                        lambda ins, p=params, pr=program: pr.call(ins, p),
-                        in_axes=(0,),
-                    )(inputs)
-                    new_blocks.append(
-                        {
-                            **{
-                                k: v for k, v in blk.items() if k not in outs
-                            },
-                            **outs,
-                        }
-                    )
-                blocks = new_blocks
+            if st.kind in ("map_blocks", "map_rows"):
+                blocks = [
+                    self._map_stage_block(st, blk, params) for blk in blocks
+                ]
             elif st.kind == "reduce_blocks":
                 program, bases = st.program, list(st.reduced_bases)
                 partials = [
@@ -548,6 +498,74 @@ class Pipeline:
                 raise AssertionError(st.kind)
         return row if self._row_stage else blocks
 
+    def _map_stage_block(
+        self, st: _Stage, blk: Dict[str, Any], params: Mapping[str, Any]
+    ) -> Dict[str, Any]:
+        """One map stage applied to ONE block dict (traced) — shared by the
+        fused whole-frame body and the device-pool per-block body, so the
+        two execution paths cannot drift semantically."""
+        if st.kind == "map_blocks":
+            n_rows = len(next(iter(blk.values())))
+            inputs = {
+                n: blk[st.program.column_for_input(n)]
+                for n in st.program.input_names
+            }
+            outs = st.program.call(inputs, params)
+            if not st.trim:
+                for name, v in outs.items():
+                    if v.ndim == 0 or v.shape[0] != n_rows:
+                        raise ValidationError(
+                            f"pipeline.map_blocks: output {name!r} "
+                            f"has shape {v.shape} but the block has "
+                            f"{n_rows} rows; use trim=True to change "
+                            f"the row count."
+                        )
+                return {
+                    **{k: v for k, v in blk.items() if k not in outs},
+                    **outs,
+                }
+            counts = {
+                v.shape[0] if v.ndim else None for v in outs.values()
+            }
+            if len(counts) != 1 or None in counts:
+                raise ValidationError(
+                    f"pipeline.map_blocks_trimmed: outputs "
+                    f"disagree on row count: "
+                    f"{ {k: v.shape for k, v in outs.items()} }"
+                )
+            return dict(outs)
+        if st.kind == "map_rows":
+            program = st.program
+            inputs = {
+                n: blk[program.column_for_input(n)]
+                for n in program.input_names
+            }
+            outs = jax.vmap(
+                lambda ins, p=params, pr=program: pr.call(ins, p),
+                in_axes=(0,),
+            )(inputs)
+            return {
+                **{k: v for k, v in blk.items() if k not in outs},
+                **outs,
+            }
+        raise AssertionError(st.kind)  # pragma: no cover
+
+    def _block_chain(
+        self, cols_blk: Dict[str, Any], params_list: List[Dict]
+    ) -> Dict[str, Any]:
+        """The map-stage chain over ONE block (traced): the device-pool
+        per-block body.  Mirrors ``_body``'s per-block handling exactly —
+        same entry casts, same stage application via
+        :meth:`_map_stage_block`."""
+        src_schema = self._frame.schema
+        blk = {}
+        for name, a in cols_blk.items():
+            st = dtypes.coerce(src_schema[name].scalar_type)
+            blk[name] = a if a.dtype == st.np_dtype else a.astype(st.np_dtype)
+        for st_, params in zip(self._stages, params_list):
+            blk = self._map_stage_block(st_, blk, params)
+        return blk
+
     def _params_list(self) -> List[Dict[str, Any]]:
         return [
             dict(st.program._params) if st.program is not None else {}
@@ -559,12 +577,28 @@ class Pipeline:
     def run(self):
         """Compile (once) and dispatch the fused chain — ONE jit call.
 
-        Returns device-resident results: a dict of arrays for row-terminal
-        chains, a TensorFrame with device columns for map-terminal chains.
-        No host sync happens here; materialise with ``collect()`` /
-        ``np.asarray`` when the values are needed."""
+        On the fused (default) path, returns device-resident results — a
+        dict of arrays for row-terminal chains, a TensorFrame with device
+        columns for map-terminal chains — with no host sync here;
+        materialise with ``collect()`` / ``np.asarray`` when the values
+        are needed.
+
+        Device pool (``ops/device_pool.py``): a MAP-terminal chain over a
+        host-fresh multi-block frame dispatches the same fused per-block
+        body across all local devices instead of one whole-frame trace —
+        blocks are independent, so the chain parallelizes exactly like
+        the eager map verbs.  On THAT path the columns come back
+        host-resident, assembled in block order, and the call
+        synchronizes on the last block (overlapped per-block readback) —
+        the pool trades the async device-resident contract for
+        cross-device parallelism.  Row-terminal chains always keep the
+        single fused dispatch: their cross-block combine shape IS the
+        executable."""
         if not self._stages:
             raise ValidationError("pipeline.run: empty pipeline (no stages)")
+        plan = self._pool_plan()
+        if plan is not None:
+            return self._run_pooled(*plan)
         with observability.verb_span(
             "pipeline", self._frame.num_rows, self._frame.num_blocks
         ) as span:
@@ -596,6 +630,182 @@ class Pipeline:
                         list(frame.columns) + extra, frame.offsets
                     )
             return frame
+
+    def _pool_plan(self):
+        """``(devices, entry layout)`` for a pooled run, or None to take
+        the fused whole-frame dispatch.  Pooling needs: a map-terminal
+        chain (map stages only), no mesh engine, >= 2 blocks, >= 2 pool
+        devices, and a fully host-resident entry set (a cached frame's
+        columns live on ONE device; splitting them would shuffle HBM).
+        The knob and layout are resolved ONCE here and threaded through
+        the whole pooled run, so a mid-call env flip cannot yield an
+        inconsistent plan."""
+        if (
+            self._row_stage
+            or self._mesh_mode
+            or self._frame.num_blocks < 2
+            or any(
+                st.kind not in ("map_blocks", "map_rows")
+                for st in self._stages
+            )
+        ):
+            return None
+        devices = device_pool.pool_devices()
+        if len(devices) < 2:
+            return None
+        layout, all_host = self._entry_layout()
+        if not layout or not all_host:
+            return None
+        return devices, layout
+
+    def _pool_pads(self, sizes: List[int], layout) -> List[Optional[int]]:
+        """Bucket targets for the pooled per-block chain (engine
+        ``_bucket_plan`` analog), or all-None for exact shapes.
+
+        Without padding an uneven frame compiles one chain executable
+        per (block size, device); with it every block lands on one
+        bucket signature per device.  Gating mirrors the engine: block
+        bucketing enabled, no trim stage (padded rows must slice back,
+        which needs row identity), and the WHOLE per-block chain proven
+        row-independent by the jaxpr proof at the exact (real, padded)
+        sizes — posed once on the composite ``_block_chain`` over the
+        entry columns, so a cross-row ``map_blocks`` stage anywhere in
+        the chain keeps exact shapes."""
+        nb = len(sizes)
+        none: List[Optional[int]] = [None] * nb
+        if not bucketing.enabled() or any(st.trim for st in self._stages):
+            return none
+        targets = [
+            bucketing.bucket_for(n) if n > 0 else None for n in sizes
+        ]
+        targets = [
+            t if t is not None and t != sizes[i] else None
+            for i, t in enumerate(targets)
+        ]
+        if all(t is None for t in targets):
+            return none
+        proof_sizes = tuple(
+            sorted(
+                {sizes[i] for i, t in enumerate(targets) if t is not None}
+                | {t for t in targets if t is not None}
+            )
+        )
+        sig = tuple(
+            sorted(
+                (n, tuple(np.shape(d)[1:]), str(np.dtype(dt)))
+                for n, (d, dt) in layout.items()
+            )
+        )
+        key = (proof_sizes, sig)
+        if key not in self._pool_proofs:
+            params_list = self._params_list()
+            probe = Program(
+                lambda **cols: self._block_chain(cols, params_list),
+                sorted(layout),
+            )
+            specs = {
+                n: jax.ShapeDtypeStruct(
+                    (2,) + tuple(np.shape(d)[1:]), np.dtype(dt)
+                )
+                for n, (d, dt) in layout.items()
+            }
+            try:
+                ok = segment_compile.rows_independent_at(
+                    probe, specs, proof_sizes
+                )
+            except Exception:
+                ok = False
+            self._pool_proofs[key] = ok
+        return targets if self._pool_proofs[key] else none
+
+    def _run_pooled(self, devices, layout):
+        """Map-terminal chain over the device pool: the fused per-block
+        body (:meth:`_block_chain`) dispatches once per block on the
+        block's assigned device, with per-device staging lanes and the
+        bounded overlapped-readback window — the pipeline face of the
+        engine's ``_map_dispatch_pool``.  Entry buffers are fresh host
+        slices staged per block, so they donate exactly like the fused
+        path's entry columns."""
+        frame = self._frame
+        with observability.verb_span(
+            "pipeline", frame.num_rows, frame.num_blocks
+        ) as span:
+            donate = prefetch.donate_inputs()
+            if donate not in self._pool_compiled:
+                self._pool_compiled[donate] = jax.jit(
+                    lambda blk, params_list: self._block_chain(
+                        blk, params_list
+                    ),
+                    **({"donate_argnums": (0,)} if donate else {}),
+                )
+            run = self._pool_compiled[donate]
+            span.mark("validate")
+            span.annotate("donate_entry", donate)
+            sizes = frame.block_sizes
+            nb = frame.num_blocks
+            assignment = device_pool.assign(sizes, len(devices))
+            pool = device_pool.PoolRun(
+                devices, assignment, prefetch.prefetch_depth() or 1
+            )
+            offsets = frame.offsets
+            host_cols = {
+                name: np.asarray(data) if not is_device_array(data) else data
+                for name, (data, _) in layout.items()
+            }
+
+            pads = self._pool_pads(sizes, layout)
+
+            def stage_block(bi, dev):
+                lo, hi = offsets[bi], offsets[bi + 1]
+                staged = {}
+                for name, (data, dt) in layout.items():
+                    a = host_cols[name][lo:hi]
+                    if a.dtype != dt:
+                        a = a.astype(dt)
+                    if pads[bi] is not None:
+                        a = bucketing.pad_rows(a, pads[bi])
+                    staged[name] = jax.device_put(a, dev)
+                return staged
+
+            lanes = device_pool.lanes(devices, assignment, stage_block)
+            lane_iters = [iter(l) for l in lanes]
+            params_list = self._params_list()
+            out_blocks: List[Optional[Dict[str, Any]]] = [None] * nb
+            for bi in range(nb):
+                di = assignment[bi]
+                staged = next(lane_iters[di])
+                outs = run(staged, params_list)
+                del staged
+                if pads[bi] is not None:
+                    # bucket-padded chain: slice the pad rows back off
+                    # (the _pool_pads proof guarantees real rows' values)
+                    outs = {k: v[: sizes[bi]] for k, v in outs.items()}
+                pool.submit(bi, di, sizes[bi], outs, out_blocks)
+            pool.finish(out_blocks)
+            span.annotate(
+                "device_pool",
+                pool.record(
+                    sum(l.stats["stage_s"] for l in lanes),
+                    sum(l.stats["wait_s"] for l in lanes),
+                ),
+            )
+            span.mark("dispatch")
+            out_frame = TensorFrame.from_blocks(out_blocks)
+            # host-only / ragged source columns pass through unchanged when
+            # the chain preserves row identity (no trim stage) — same rule
+            # as the fused path
+            if not any(s.trim for s in self._stages):
+                extra = [
+                    c
+                    for c in frame.columns
+                    if c.info.name not in out_frame.column_names
+                    and c.info.name not in self._visible
+                ]
+                if extra:
+                    out_frame = TensorFrame(
+                        list(out_frame.columns) + extra, out_frame.offsets
+                    )
+            return out_frame
 
     def _entry_layout(self) -> Tuple[Dict[str, Any], bool]:
         """``name -> (column data, effective entry dtype)`` plus whether

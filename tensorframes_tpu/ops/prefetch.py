@@ -54,6 +54,12 @@ The per-verb stats (:attr:`Prefetcher.stats`) record how much of the
 staging wall time was hidden behind compute; the engine attaches them to
 the verb span (``observability``) and ``bench.py`` reports the overlap
 ratio for the streaming-ingestion leg.
+
+Device-pool composition (round 8, ``ops/device_pool.py``): the pool
+scheduler runs ONE Prefetcher per local device — each lane stages its
+device's blocks in block order with ``device_put`` pointed at that
+device (``name="tfs-pool-d<k>"``), and the donation contract above
+carries over unchanged because only host-fresh frames ever pool.
 """
 
 from __future__ import annotations
@@ -130,10 +136,15 @@ class Prefetcher:
         stage: Callable[[int], Any],
         num_items: int,
         depth: Optional[int] = None,
+        name: str = "tfs-prefetch",
     ):
         self._stage = stage
         self._n = int(num_items)
         self._depth = prefetch_depth() if depth is None else max(0, depth)
+        # thread name: the device-pool scheduler runs one lane per device
+        # ("tfs-pool-d<k>"), and distinguishable names matter in py-spy /
+        # profiler dumps when several lanes stage concurrently
+        self._name = name
         self.stats: Dict[str, Any] = {
             "items": self._n,
             "depth": self._depth,
@@ -187,7 +198,7 @@ class Prefetcher:
                         continue
 
         t = threading.Thread(
-            target=worker, name="tfs-prefetch", daemon=True
+            target=worker, name=self._name, daemon=True
         )
         t.start()
         try:

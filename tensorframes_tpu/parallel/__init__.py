@@ -8,8 +8,17 @@ with XLA collectives over a ``jax.sharding.Mesh``:
 * P4 driver-coordinated pairwise reduce -> on-device tree / ``psum`` over ICI;
 * P5 shuffle-grouped aggregation -> device-side keyed reduction;
 * P6 program broadcast -> the jit cache (PJRT ships the executable).
+
+Between the single-device ``Executor`` and the GSPMD ``MeshExecutor`` sits
+the **device-pool scheduler** (``ops/device_pool.py``, re-exported here):
+the default ``Executor`` spreads a host-fresh frame's independent blocks
+across all local devices — per-device prefetch lanes, async dispatch,
+overlapped readback — which is the paper's per-partition data parallelism
+at single-host scale, with no mesh and no collectives.  ``TFS_DEVICE_POOL``
+sizes it; ``pool_devices()``/``pool_enabled()`` report the resolved pool.
 """
 
+from ..ops.device_pool import enabled as pool_enabled, pool_devices
 from .dist import MeshExecutor
 from .mesh import data_mesh, device_count, training_mesh
 from .multihost import (
@@ -28,4 +37,6 @@ __all__ = [
     "frame_from_process_local",
     "process_count",
     "process_index",
+    "pool_devices",
+    "pool_enabled",
 ]

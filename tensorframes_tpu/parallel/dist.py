@@ -63,6 +63,11 @@ class MeshExecutor(Executor):
     # Non-monoid programs keep the groups-axis-sharded general path.
     supports_segment_aggregate = True
 
+    # the mesh IS this executor's multi-device story: GSPMD shards one
+    # logical computation, so the block-parallel device pool
+    # (ops/device_pool.py) must not also claim the same chips
+    supports_device_pool = False
+
     def _segment_pad_rows(self, n: int) -> int:
         # bare-monoid segment aggregates pad to a data-axis multiple with
         # reduction identities (engine._aggregate_segment), so uneven row

@@ -15,6 +15,16 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The block-parallel device pool (ops/device_pool.py) would engage by
+# default on this 8-device test mesh and dispatch every multi-block map
+# verb across all 8 virtual devices — one executable (and one program
+# trace) PER DEVICE, which breaks the suite's trace/compile-count fences
+# (test_bucketing, test_observability) and makes span stats
+# nondeterministic.  The main suite therefore pins the single-device
+# baseline; the device-pool tests (tests/test_device_pool.py) re-enable
+# the pool explicitly per test, and run process-isolated below.
+os.environ.setdefault("TFS_DEVICE_POOL", "0")
+
 import jax  # noqa: E402
 
 # The axon environment's sitecustomize force-registers the TPU backend and
@@ -88,6 +98,12 @@ _MESH_PAT = re.compile(
 # the fragile subclass: manual collectives (ring ppermutes, the pipeline
 # schedules) inside shard_map — every observed native crash is in this class
 _FRAGILE_PAT = re.compile(r"ppermute|1f1b|pipelined|pipeline_schedule")
+# device-pool dispatch tests (tests/test_device_pool.py, names
+# ``test_pooled_*``): each spawns its own interpreter on the forced
+# 8-device CPU mesh, so pool scheduling (multi-device jit caches, staged
+# lanes, env-knob flips) never leaks compiled-per-device state or timing
+# interference into the single-device-pinned main suite
+_POOL_PAT = re.compile(r"test_pooled_")
 
 
 def pytest_configure(config):
@@ -102,6 +118,13 @@ def pytest_configure(config):
         "collectives; each runs in its own interpreter (fresh XLA:CPU "
         "runtime) with native-death-only retries",
     )
+    config.addinivalue_line(
+        "markers",
+        "pool_isolated: auto-applied to device-pool dispatch tests "
+        "(test_pooled_*); each runs in its own interpreter under the "
+        "forced 8-device XLA_FLAGS so multi-device scheduling never "
+        "shares a process with the single-device-pinned main suite",
+    )
 
 
 def _item_source(item) -> str:
@@ -114,7 +137,9 @@ def _item_source(item) -> str:
         return ""
 
 
-def _run_in_subprocess(nodeid: str, rootpath: str, attempts: int = 4):
+def _run_in_subprocess(
+    nodeid: str, rootpath: str, attempts: int = 4, extra_env=None
+):
     proc = None
     for attempt in range(attempts):
         proc = subprocess.run(
@@ -129,7 +154,7 @@ def _run_in_subprocess(nodeid: str, rootpath: str, attempts: int = 4):
                 "no:cacheprovider",
             ],
             cwd=rootpath,
-            env={**os.environ, _ISOLATED_ENV: "1"},
+            env={**os.environ, _ISOLATED_ENV: "1", **(extra_env or {})},
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -150,7 +175,7 @@ def _run_in_subprocess(nodeid: str, rootpath: str, attempts: int = 4):
     )
 
 
-def _isolate_item(item) -> None:
+def _isolate_item(item, extra_env=None) -> None:
     inner = item.obj
     nodeid = item.nodeid
     rootpath = str(item.config.rootpath)
@@ -159,14 +184,30 @@ def _isolate_item(item) -> None:
     def wrapper(*args, **kwargs):
         if os.environ.get(_ISOLATED_ENV) == "1":
             return inner(*args, **kwargs)
-        _run_in_subprocess(nodeid, rootpath)
+        _run_in_subprocess(nodeid, rootpath, extra_env=extra_env)
 
     item.obj = wrapper
+
+
+def _pool_test_env() -> dict:
+    """Env for an isolated device-pool test child: the forced 8-device
+    CPU mesh, pinned explicitly (belt and braces — the child's conftest
+    sets the same flags, but the child must see them even if invoked
+    with a caller-tweaked XLA_FLAGS)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    return {"XLA_FLAGS": flags, "JAX_PLATFORMS": "cpu"}
 
 
 def pytest_collection_modifyitems(config, items):
     isolate_mode = os.environ.get("TFS_ISOLATE", "")
     for item in items:
+        if _POOL_PAT.search(item.name):
+            item.add_marker(pytest.mark.pool_isolated)
+            if isolate_mode != "0":
+                _isolate_item(item, extra_env=_pool_test_env())
+            continue
         src = _item_source(item)
         fixtures = set(getattr(item, "fixturenames", ()))
         uses_mesh = bool(_MESH_PAT.search(src)) or "devices" in fixtures

@@ -230,3 +230,30 @@ def test_mixed_sizes_error_elides_long_row_lists():
 
     assert _fmt_rows([0, 3, 7]) == "0, 3, 7"
     assert _fmt_rows(list(range(12))) == "0, 1, 2, 3, 4, 5, 6, 7, … (+4 more)"
+
+
+def test_byte_consumer_beyond_decode_chain_rejected():
+    """A placeholder that feeds a Decode* prelude is re-fed DECODED
+    pixels, so any other reachable consumer of its bytes must be
+    rejected at import, naming both consumers — not silently fed uint8
+    pixels (round-8, advisor r5)."""
+    g = GraphBuilder()
+    g.placeholder("contents", "binary", [])
+    g.op("Identity", "i1", ["contents"])
+    g.op("DecodeJpeg", "d", ["i1"])
+    g.op("Neg", "n", ["i1"])  # reads the bytes past the decode chain
+    with pytest.raises(GraphImportError, match=r"'d'.*'n'|d\).*'n'"):
+        import_graphdef(g.to_bytes(), fetches=["d", "n"])
+    # pruning still applies: with the conflicting consumer unreachable,
+    # the same graph imports fine
+    import_graphdef(g.to_bytes(), fetches=["d"])
+
+
+def test_fetch_of_decoded_placeholder_rejected():
+    """Fetching the decoded placeholder (or its Identity chain) would
+    silently return pixels where the graph promises bytes."""
+    g = GraphBuilder()
+    g.placeholder("contents", "binary", [])
+    g.op("DecodeJpeg", "d", ["contents"])
+    with pytest.raises(GraphImportError, match="pixels"):
+        import_graphdef(g.to_bytes(), fetches=["d", "contents"])

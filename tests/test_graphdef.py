@@ -1115,3 +1115,87 @@ def test_complete_for_tf_out_of_range_output_leaves_attr_unset():
     done = complete_for_tf(GraphDef(nodes)).node_map()
     assert done["keep"].attrs["T"].value == 2
     assert "T" not in done["oob"].attrs
+
+
+# -------------------------------------- function-body output refs (r8) --
+
+
+def test_function_output_arg_index_not_dropped(monkeypatch):
+    """A ``node:arg:idx`` body ref must honour the index WITHIN a sized
+    output arg: flat slot = named arg's position + idx.  Round-8
+    regression — idx was dropped for ``_OUTPUT_ARGS`` ops, so any future
+    number_attr-sized output arg would silently alias its slot 0."""
+    from tensorframes_tpu.graphdef import importer as imp
+    from tensorframes_tpu.graphdef import ops as op_registry
+    from tensorframes_tpu.graphdef.proto import AttrValue, FunctionDef, NodeDef
+
+    def fake_multi(ins, attrs):
+        (x,) = ins
+        # output args ("first", "parts"): first is one tensor, parts is a
+        # number_attr-sized pair -> flat tuple (first, parts[0], parts[1])
+        return (x + 1.0, x + 2.0, x + 3.0)
+
+    monkeypatch.setitem(op_registry.REGISTRY, "FakeMultiOut", fake_multi)
+    monkeypatch.setitem(
+        imp._OUTPUT_ARGS, "FakeMultiOut", ("first", "parts")
+    )
+    fd = FunctionDef(
+        "fb",
+        [("ax", 2)],
+        [("r", 2), ("r2", 2)],
+        [NodeDef("m", "FakeMultiOut", ["ax"], {})],
+        {"r": "m:parts:1", "r2": "m:first:0"},
+    )
+    nodes = [
+        NodeDef("x", "Placeholder", [], {"dtype": AttrValue("type", 2)}),
+        NodeDef(
+            "call",
+            "PartitionedCall",
+            ["x"],
+            {"f": AttrValue("func", ("fb", {}))},
+        ),
+    ]
+    g = GraphDef(nodes, {"fb": fd})
+    p = import_graphdef(g, fetches=["call:0", "call:1"])
+    out = p.call({"x": np.arange(3.0)})
+    # parts:1 is the SECOND tensor of the sized arg -> flat slot 2 (x+3),
+    # not the arg's slot 1 (x+2) the dropped-index bug returned
+    np.testing.assert_allclose(np.asarray(out["call"]), np.arange(3.0) + 3.0)
+    np.testing.assert_allclose(
+        np.asarray(out["call_1"]), np.arange(3.0) + 1.0
+    )
+
+
+def test_function_output_arg_inner_index_on_nonfinal_arg_rejected(monkeypatch):
+    """Indexing INTO a named output arg that precedes other args cannot
+    be resolved without per-arg sizes — refuse loudly, never alias."""
+    from tensorframes_tpu.graphdef import importer as imp
+    from tensorframes_tpu.graphdef import ops as op_registry
+    from tensorframes_tpu.graphdef.proto import AttrValue, FunctionDef, NodeDef
+
+    monkeypatch.setitem(
+        op_registry.REGISTRY, "FakeMultiOut",
+        lambda ins, attrs: (ins[0], ins[0] + 1.0, ins[0] + 2.0),
+    )
+    monkeypatch.setitem(
+        imp._OUTPUT_ARGS, "FakeMultiOut", ("parts", "last")
+    )
+    fd = FunctionDef(
+        "fb",
+        [("ax", 2)],
+        [("r", 2)],
+        [NodeDef("m", "FakeMultiOut", ["ax"], {})],
+        {"r": "m:parts:1"},  # sized arg is NOT last: base unknowable
+    )
+    nodes = [
+        NodeDef("x", "Placeholder", [], {"dtype": AttrValue("type", 2)}),
+        NodeDef(
+            "call",
+            "PartitionedCall",
+            ["x"],
+            {"f": AttrValue("func", ("fb", {}))},
+        ),
+    ]
+    p = import_graphdef(GraphDef(nodes, {"fb": fd}), fetches=["call:0"])
+    with pytest.raises(GraphImportError, match="precedes other output"):
+        p.call({"x": np.arange(3.0)})

@@ -20,5 +20,21 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 JAX_PLATFORMS=cpu \
   python -m pytest tests/test_device_pool.py -q
 
+# Chaos tier: the fault-tolerance tests re-run under a TFS_FAULT_INJECT
+# matrix (rate:seed pairs consumed by the chaos-parameterised tests via
+# TFS_CHAOS_RATE/TFS_CHAOS_SEED).  The injection schedule is a
+# deterministic function of (seed, block, attempt), so each matrix point
+# is exactly reproducible — a failure here is a real recovery bug, not
+# flakiness.  Pooled chaos tests (test_pooled_*) self-isolate into fresh
+# interpreters via conftest, same as the device-pool tier.
+echo "== chaos tier (deterministic fault injection) =="
+for rs in "0.25:7" "0.4:11"; do
+  echo "-- chaos rate=${rs%%:*} seed=${rs##*:} --"
+  TFS_CHAOS_RATE="${rs%%:*}" TFS_CHAOS_SEED="${rs##*:}" \
+  XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+  JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_fault_tolerance.py -q
+done
+
 echo "== pytest =="
 exec python -m pytest tests/ -q --ignore=tests/test_device_pool.py "$@"

@@ -18,7 +18,7 @@ The user-facing module mirrors the reference's python API
     s = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, tf)
 """
 
-from . import compile_cache, dsl, observability, resilience
+from . import compile_cache, dsl, faults, observability, resilience
 from .analyze import analyze, explain, print_schema
 from .builder import OpBuilder
 from .observability import initialize_logging
@@ -76,6 +76,7 @@ __all__ = [
     "observability",
     "initialize_logging",
     "resilience",
+    "faults",
     "analyze",
     "explain",
     "print_schema",

@@ -1,0 +1,239 @@
+"""Deterministic fault injection for the block execution stack.
+
+The reference tests its failure story with Spark's own chaos levers —
+kill an executor, let task retry replay the partition from lineage
+(SURVEY.md §5).  A TPU host has no such lever: XLA faults (`UNAVAILABLE`
+preemptions, `RESOURCE_EXHAUSTED` OOMs) come from real hardware state
+that a test cannot provoke on demand.  This module supplies the lever:
+``TFS_FAULT_INJECT`` describes an *exact, reproducible* failure schedule
+and the engine's dispatch boundary (``ops/fault_tolerance.py``) consults
+it before every block (and split sub-range) dispatch.
+
+Spec grammar — ``;``-separated specs, each ``kind:key=value:...``::
+
+    TFS_FAULT_INJECT="transient:block=3:attempt=0"
+    TFS_FAULT_INJECT="oom:device=1:rate=0.25:seed=7"
+    TFS_FAULT_INJECT="delay:ms=50;transient:rate=0.25:seed=7"
+
+Kinds:
+
+* ``transient`` — raise :class:`InjectedTransient` (message opens with
+  ``UNAVAILABLE:`` so ``resilience.FailureDetector`` classifies it
+  transient, exactly like a real preemption);
+* ``oom`` — raise :class:`InjectedOOM` (opens with
+  ``RESOURCE_EXHAUSTED:``, the real XLA OOM status — drives the engine's
+  block-splitting degradation, not the retry loop);
+* ``delay`` — sleep ``ms`` milliseconds at the dispatch boundary
+  (staging-skew chaos without failing anything).
+
+Selectors (all optional; a spec fires when every given selector
+matches):
+
+* ``block=N`` — only block index N;
+* ``device=N`` — only dispatches bound for pool device index N (the
+  serial path dispatches as device 0);
+* ``attempt=N`` — only retry attempt N of a block dispatch (``0`` = the
+  first try, so retry 1 succeeds).  Attempt-selected specs never fire on
+  OOM-split sub-dispatches — those are recovery work, not fresh
+  attempts;
+* ``rate=F`` + ``seed=S`` — fire with probability F, decided by a
+  *counter-free deterministic draw* hashed from ``(seed, block,
+  attempt)``: the same spec over the same frame produces the same
+  schedule in every process, which is what lets the chaos tests assert
+  bit-identity instead of flakiness;
+* ``minrows=N`` — only dispatches covering >= N rows (the way to make an
+  injected OOM *stop* firing once the engine has split the block small
+  enough).
+
+Injection is wired through ONE choke point (:func:`maybe_inject`), off
+by default (unset/empty env), and counted in
+``observability.counters()['faults_injected']`` so a chaos bench record
+can prove how much adversity it actually ran under.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import time
+from typing import List, Optional, Tuple
+
+from . import observability
+
+logger = logging.getLogger("tensorframes_tpu.faults")
+
+ENV_VAR = "TFS_FAULT_INJECT"
+
+_KINDS = ("transient", "oom", "delay")
+_INT_KEYS = ("block", "device", "attempt", "minrows", "seed")
+_FLOAT_KEYS = ("rate", "ms")
+
+
+class InjectedTransient(RuntimeError):
+    """An injected runtime-infrastructure failure (classifies transient)."""
+
+
+class InjectedOOM(RuntimeError):
+    """An injected device out-of-memory (classifies RESOURCE_EXHAUSTED)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    kind: str
+    block: Optional[int] = None
+    device: Optional[int] = None
+    attempt: Optional[int] = None
+    minrows: Optional[int] = None
+    rate: Optional[float] = None
+    seed: int = 0
+    ms: float = 0.0
+    index: int = 0  # position in the spec list (decorrelates rate draws)
+
+    def matches(
+        self,
+        block: int,
+        attempt: int,
+        device: Optional[int],
+        n_rows: Optional[int],
+        site: str,
+    ) -> bool:
+        if self.block is not None and self.block != block:
+            return False
+        if self.device is not None and self.device != device:
+            return False
+        if self.attempt is not None:
+            # attempt selectors describe the RETRY schedule of a block
+            # dispatch; split sub-dispatches are recovery, not attempts
+            if site != "dispatch" or self.attempt != attempt:
+                return False
+        if self.minrows is not None and (
+            n_rows is None or n_rows < self.minrows
+        ):
+            return False
+        if self.rate is not None:
+            draw = random.Random(
+                f"{self.seed}:{self.index}:{self.kind}:{block}:{attempt}"
+            ).random()
+            if draw >= self.rate:
+                return False
+        return True
+
+
+_warned: set = set()
+
+
+def _warn_once(raw: str, why: str) -> None:
+    if raw not in _warned:
+        _warned.add(raw)
+        logger.warning(
+            "%s spec %r ignored: %s (grammar: kind:key=value:... with "
+            "kind in %s)",
+            ENV_VAR,
+            raw,
+            why,
+            "/".join(_KINDS),
+        )
+
+
+def _parse_one(raw: str, index: int) -> Optional[FaultSpec]:
+    parts = [p for p in raw.strip().split(":") if p]
+    if not parts:
+        return None
+    kind = parts[0].strip().lower()
+    if kind not in _KINDS:
+        _warn_once(raw, f"unknown kind {kind!r}")
+        return None
+    fields = {"kind": kind, "index": index}
+    for part in parts[1:]:
+        if "=" not in part:
+            _warn_once(raw, f"selector {part!r} is not key=value")
+            return None
+        key, _, val = part.partition("=")
+        key = key.strip().lower()
+        try:
+            if key in _INT_KEYS:
+                fields[key] = int(val)
+            elif key in _FLOAT_KEYS:
+                fields[key] = float(val)
+            else:
+                _warn_once(raw, f"unknown selector {key!r}")
+                return None
+        except ValueError:
+            _warn_once(raw, f"selector {key}={val!r} is not numeric")
+            return None
+    return FaultSpec(**fields)
+
+
+_cache: Tuple[str, List[FaultSpec]] = ("", [])
+
+
+def specs() -> List[FaultSpec]:
+    """The parsed ``TFS_FAULT_INJECT`` plan (cached per env value; read
+    per call so tests and bench legs can flip it mid-process)."""
+    global _cache
+    raw = os.environ.get(ENV_VAR, "").strip()
+    if raw == _cache[0]:
+        return _cache[1]
+    parsed = []
+    if raw:
+        for i, part in enumerate(raw.split(";")):
+            spec = _parse_one(part, i)
+            if spec is not None:
+                parsed.append(spec)
+    _cache = (raw, parsed)
+    return parsed
+
+
+def active() -> bool:
+    """Whether any injection spec is live."""
+    return bool(specs())
+
+
+def maybe_inject(
+    block: int,
+    attempt: int,
+    device: Optional[int] = None,
+    n_rows: Optional[int] = None,
+    site: str = "dispatch",
+) -> None:
+    """The dispatch-boundary hook: sleep for every matching ``delay``
+    spec, then raise for the first matching ``transient``/``oom`` spec.
+    A no-op (one truthiness check) when ``TFS_FAULT_INJECT`` is unset."""
+    plan = specs()
+    if not plan:
+        return
+    for spec in plan:
+        if not spec.matches(block, attempt, device, n_rows, site):
+            continue
+        if spec.kind == "delay":
+            time.sleep(spec.ms / 1000.0)
+            continue
+        observability.note_fault_injected()
+        where = (
+            f"block={block} attempt={attempt} device={device} "
+            f"rows={n_rows} site={site}"
+        )
+        if spec.kind == "transient":
+            raise InjectedTransient(
+                f"UNAVAILABLE: injected transient fault ({where})"
+            )
+        raise InjectedOOM(
+            f"RESOURCE_EXHAUSTED: injected out-of-memory ({where})"
+        )
+
+
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted", "out of memory")
+
+
+def is_oom(exc: BaseException, _depth: int = 0) -> bool:
+    """Whether ``exc`` (or its ``__cause__`` chain) is a device
+    out-of-memory — real XLA ``RESOURCE_EXHAUSTED`` statuses and
+    :class:`InjectedOOM` alike."""
+    text = str(exc).lower()
+    if any(m in text for m in _OOM_MARKERS):
+        return True
+    if _depth < 4 and exc.__cause__ is not None:
+        return is_oom(exc.__cause__, _depth + 1)
+    return False

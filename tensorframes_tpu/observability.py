@@ -62,6 +62,12 @@ _counters: Dict[str, int] = {
     "persistent_cache_hits": 0,
     "persistent_cache_misses": 0,
     "pool_blocks": 0,
+    # fault tolerance (round 9): the recovery layer's evidence counters
+    "block_retries": 0,
+    "block_oom_splits": 0,
+    "devices_quarantined": 0,
+    "faults_injected": 0,
+    "pool_copy_fallbacks": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
 
@@ -102,6 +108,34 @@ def note_pool_dispatch() -> None:
     per block dispatched through the pool — the always-on counter that
     lets a bench record prove pool utilisation rather than assert it."""
     _counters["pool_blocks"] += 1
+
+
+def note_block_retry() -> None:
+    """One transient block-dispatch failure absorbed by the per-block
+    retry loop (``ops/fault_tolerance.py``)."""
+    _counters["block_retries"] += 1
+
+
+def note_oom_split() -> None:
+    """One OOM-degradation binary split performed on a map-verb block."""
+    _counters["block_oom_splits"] += 1
+
+
+def note_device_quarantined() -> None:
+    """One pool device drained after repeated transient failures."""
+    _counters["devices_quarantined"] += 1
+
+
+def note_fault_injected() -> None:
+    """One fault raised by the ``TFS_FAULT_INJECT`` harness
+    (``faults.py``) — chaos evidence for tests and the bench."""
+    _counters["faults_injected"] += 1
+
+
+def note_pool_copy_fallback() -> None:
+    """One ``copy_to_host_async`` failure in the pool readback window
+    that fell back to synchronous readback (``PoolRun.submit``)."""
+    _counters["pool_copy_fallbacks"] += 1
 
 
 @contextlib.contextmanager
@@ -174,6 +208,11 @@ def counters_delta(
             "persistent_cache_hits",
             "persistent_cache_misses",
             "pool_blocks",
+            "block_retries",
+            "block_oom_splits",
+            "devices_quarantined",
+            "faults_injected",
+            "pool_copy_fallbacks",
         )
     }
 

@@ -68,12 +68,22 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .. import observability
-from . import prefetch
+from .. import observability, resilience
+from . import fault_tolerance, prefetch
 
 logger = logging.getLogger("tensorframes_tpu.device_pool")
 
 ENV_VAR = "TFS_DEVICE_POOL"
+
+# the exception classes a failed ``copy_to_host_async`` may legitimately
+# raise (backend lacks the method's semantics, buffer already on host,
+# runtime refused the async copy): jax runtime errors plus the plain
+# RuntimeError/NotImplementedError some PJRT clients use.  Narrow by
+# design — a TypeError here is a bug and must propagate.
+_COPY_FALLBACK_TYPES = (
+    RuntimeError,
+    NotImplementedError,
+) + resilience._runtime_error_types()
 
 _warned: set = set()
 
@@ -200,6 +210,59 @@ class PoolRun:
         self._last_done: List[Optional[float]] = [None] * n
         self.drain_s = 0.0
         self._t0 = time.perf_counter()
+        # fault tolerance (round 9): per-device transient-failure counts
+        # and the quarantine set the retry layer consults
+        # (ops/fault_tolerance.py); the threshold is captured once so a
+        # mid-run env flip cannot split one run's policy
+        self.failures = [0] * n
+        self.quarantined: set = set()
+        self._quarantine_after = fault_tolerance.quarantine_after()
+        self._copy_warned = False
+
+    # -- fault tolerance -----------------------------------------------------
+
+    def note_block_failure(self, di: int) -> bool:
+        """Record one transient dispatch failure on device ``di``;
+        returns True when this failure newly quarantines the device.
+        A quarantined device receives no further blocks this run —
+        :meth:`effective_device` redirects them to healthy devices
+        (Spark's executor blacklisting, at pool scope)."""
+        self.failures[di] += 1
+        if di in self.quarantined:
+            return False
+        if self.failures[di] < self._quarantine_after:
+            return False
+        self.quarantined.add(di)
+        observability.note_device_quarantined()
+        healthy = len(self.devices) - len(self.quarantined)
+        logger.warning(
+            "device %d quarantined after %d transient failures; "
+            "re-dispatching its blocks across %d healthy device(s)%s",
+            di,
+            self.failures[di],
+            healthy,
+            " (pool degraded to the serial path)" if healthy <= 1 else "",
+        )
+        return True
+
+    def effective_device(self, di: int) -> int:
+        """The device index block work assigned to ``di`` should actually
+        dispatch to: ``di`` while healthy, else the least-loaded healthy
+        device (deterministic: ties to the lowest index).  With one
+        healthy device left this is, by construction, the serial path on
+        that device; with none left the frame fails loudly."""
+        if di not in self.quarantined:
+            return di
+        healthy = [
+            k for k in range(len(self.devices)) if k not in self.quarantined
+        ]
+        if not healthy:
+            raise fault_tolerance.BlockExecutionError(
+                f"device pool: all {len(self.devices)} devices are "
+                f"quarantined (failure counts: {self.failures}); no "
+                f"healthy device remains to re-dispatch blocks"
+            )
+        return min(healthy, key=lambda k: (self.rows[k], k))
 
     # -- dispatch/readback ---------------------------------------------------
 
@@ -229,8 +292,23 @@ class PoolRun:
             if copy is not None:
                 try:
                     copy()
-                except Exception:
-                    pass  # readback still happens synchronously below
+                except _COPY_FALLBACK_TYPES as e:
+                    # readback still happens synchronously below — but a
+                    # swallowed failure is a lost overlap, so it is
+                    # counted (pool_copy_fallbacks) and logged once per
+                    # run; anything outside the expected runtime-error
+                    # types propagates (a TypeError here is a bug, not a
+                    # backend quirk)
+                    observability.note_pool_copy_fallback()
+                    if not self._copy_warned:
+                        self._copy_warned = True
+                        logger.warning(
+                            "copy_to_host_async failed (%s: %s); falling "
+                            "back to synchronous readback for this run "
+                            "(counted in pool_copy_fallbacks)",
+                            type(e).__name__,
+                            e,
+                        )
         self._window[di].append((bi, outs))
         while len(self._window[di]) > self.depth:
             self._materialize(di, out_blocks)
@@ -269,7 +347,7 @@ class PoolRun:
             busy = max(0.0, t_done - t_first)
             occupancy.append(round(min(1.0, busy / wall), 4))
             idle_s.append(round(max(0.0, wall - busy), 6))
-        return {
+        rec = {
             "devices": len(self.devices),
             "depth": self.depth,
             "blocks_per_device": list(self.blocks),
@@ -284,3 +362,7 @@ class PoolRun:
             ),
             "wall_s": round(wall, 6),
         }
+        if any(self.failures):
+            rec["failures_per_device"] = list(self.failures)
+            rec["quarantined_devices"] = sorted(self.quarantined)
+        return rec

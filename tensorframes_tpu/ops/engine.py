@@ -36,6 +36,7 @@ with collective cross-shard reduction for the GSPMD form.
 from __future__ import annotations
 
 import functools
+import logging
 import os
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -43,13 +44,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import dtypes, observability
+from .. import dtypes, faults, observability
 from ..frame import Column, TensorFrame
 from ..program import Program
 from ..schema import ColumnInfo, Schema
 from ..shape import Shape, ShapeError, UNKNOWN
-from . import bucketing, device_pool, prefetch, segment_compile, validation
+from . import (
+    bucketing,
+    device_pool,
+    fault_tolerance,
+    prefetch,
+    segment_compile,
+    validation,
+)
 from .validation import ValidationError
+
+_log = logging.getLogger("tensorframes_tpu.engine")
 
 
 def _check_shape_hints(
@@ -339,6 +349,9 @@ class Executor:
         rows_level: bool = False,
         pf_stats: Optional[Dict[str, Any]] = None,
         device=None,
+        bi: int = 0,
+        session=None,
+        device_resolver=None,
     ) -> Dict[str, Any]:
         """Chunked h2d + dispatch: equal row slices (last may be short, so
         at most two executables trace), outputs concatenated on device.
@@ -353,7 +366,11 @@ class Executor:
         vmapped cell entry (map_rows); ``pf_stats`` (a caller-LOCAL dict,
         never a live Prefetcher's stats — the outer staging thread writes
         those concurrently) accumulates the chunk prefetcher's totals for
-        the caller's span record."""
+        the caller's span record.  ``device_resolver``: zero-arg callable
+        returning the CURRENT ``(device index, device)`` target under the
+        pool — re-resolved per retry attempt so chunk re-dispatches
+        follow a quarantine redirect instead of hammering a drained
+        device (serial callers leave it None: device 0, ``device``)."""
         names = program.input_names
         arrays = {}
         n_rows = 0
@@ -367,7 +384,7 @@ class Executor:
         # by construction).  The pad rows are sliced off the concat.
         pad_tail = bucketing.enabled() and n_rows % per != 0
 
-        def stage(k):
+        def stage(k, _dev=None):
             sl = slice(starts[k], min(starts[k] + per, n_rows))
             staged = {
                 nm: arrays[nm][sl] for nm in names
@@ -378,7 +395,9 @@ class Executor:
                 }
             return {
                 nm: self._device_value(
-                    v, dtypes.coerce(infos[nm].scalar_type), device=device
+                    v,
+                    dtypes.coerce(infos[nm].scalar_type),
+                    device=_dev if _dev is not None else device,
                 )
                 for nm, v in staged.items()
             }
@@ -390,11 +409,68 @@ class Executor:
             else self._block_run(program, donate)
         )
         pf = prefetch.Prefetcher(stage, len(starts))
-        outs: List[Dict[str, Any]] = [run(inputs) for inputs in pf]
+        if session is None:
+            outs: List[Dict[str, Any]] = [run(inputs) for inputs in pf]
+        else:
+            # chunk-granular retry: each chunk dispatch is its own
+            # attempt unit (fault injection keys on the BLOCK index, so
+            # a block-selected spec fires per chunk — deterministic
+            # either way).  A retried chunk re-stages on the consumer
+            # thread; its fresh buffers stay donation-eligible.  No OOM
+            # split here: chunks are already the streaming granularity,
+            # so a chunk OOM surfaces with its exact row range.
+            outs = []
+            for k, inputs in enumerate(pf):
+                lo = starts[k]
+                hi = min(starts[k] + per, n_rows)
+                holder = {"v": inputs}
+                del inputs
+
+                def attempt(a, dev_i, _k=k, _h=holder):
+                    ins = _h.pop("v", None)
+                    if a > 0 or ins is None:
+                        # re-stage to the CURRENT effective device, so a
+                        # retried chunk follows a quarantine redirect
+                        dev_now = (
+                            device_resolver()[1]
+                            if device_resolver is not None
+                            else None
+                        )
+                        ins = stage(_k, dev_now)
+                    return run(ins)
+
+                outs.append(
+                    session.run(
+                        bi,
+                        hi - lo,
+                        attempt,
+                        device=(
+                            (lambda: device_resolver()[0])
+                            if device_resolver is not None
+                            else 0
+                        ),
+                        row_range=(lo, hi),
+                    )
+                )
         if pf_stats is not None:
             pf_stats["items"] += pf.stats["items"]
             pf_stats["stage_s"] += pf.stats["stage_s"]
             pf_stats["wait_s"] += pf.stats["wait_s"]
+        if (
+            session is not None
+            and device_resolver is not None
+            and session.pool is not None
+            and session.pool.quarantined
+        ):
+            # a mid-block quarantine redirect left chunk outputs on more
+            # than one device; co-locate them on the current effective
+            # device before the concat (committed arrays on different
+            # devices cannot feed one op)
+            _, dev_final = device_resolver()
+            outs = [
+                {k2: jax.device_put(v, dev_final) for k2, v in o.items()}
+                for o in outs
+            ]
         cat = {k: jnp.concatenate([o[k] for o in outs]) for k in outs[0]}
         if pad_tail:
             cat = {k: v[:n_rows] for k, v in cat.items()}
@@ -589,10 +665,15 @@ class Executor:
             if (self.supports_device_pool and fresh and frame.num_blocks > 1)
             else []
         )
+        # block-level fault tolerance (ops/fault_tolerance.py): None when
+        # TFS_BLOCK_RETRIES=0 and no fault injection — the default — so
+        # the dispatch loops below are byte-identical to the retry-free
+        # engine and the suite's trace/compile fences stay deterministic
+        session = fault_tolerance.frame_session(frame.num_blocks, verb=verb)
         if len(pool_devs) >= 2:
             return self._map_dispatch_pool(
                 program, frame, infos, host_stage, span, rows_level, trim,
-                plans, pads, donate, pool_devs,
+                plans, pads, donate, pool_devs, session,
             )
         # only spin up a staging thread when some block will actually
         # stage on it; otherwise (device-resident frame, or every block
@@ -622,7 +703,14 @@ class Executor:
                 outs = self._run_block_streamed(
                     program, frame.block(bi), infos, plans[bi],
                     rows_level=rows_level, pf_stats=chunk_stats,
+                    bi=bi, session=session,
                 )
+            elif session is not None:
+                outs = self._run_block_ft(
+                    session, program, frame, bi, infos, host_stage,
+                    pads[bi], rows_level, trim, donate and fresh, staged,
+                )
+                del staged
             else:
                 inputs = (
                     staged
@@ -672,6 +760,8 @@ class Executor:
                 "donate": donate and fresh,
             },
         )
+        if session is not None and session.events():
+            span.annotate("fault_tolerance", session.record())
         return out_blocks
 
     def _check_block_outputs(
@@ -705,6 +795,258 @@ class Executor:
                 )
         _check_shape_hints(program, outs, verb, cell_level=rows_level)
 
+    # -- fault-tolerant dispatch (round 9, ops/fault_tolerance.py) ----------
+
+    def _lane_next(self, it, lane_dead, li: int, session, pool):
+        """Pull the next staged value from a pool lane.  Without a retry
+        session, staging failures propagate exactly as before.  With
+        one, a failed lane is marked dead (its worker has exited; its
+        Prefetcher raises once then StopIterations), the failure counts
+        against the lane's device, and the consumer re-stages every
+        later block of that lane itself — recovery trades the staging
+        overlap for completing the frame."""
+        if lane_dead[li]:
+            return None
+        try:
+            return next(it)
+        except StopIteration:
+            raise
+        except BaseException as exc:  # noqa: BLE001 - recovery below
+            if session is None:
+                raise
+            lane_dead[li] = True
+            if pool is not None and li < len(pool.devices):
+                pool.note_block_failure(li)
+            _log.warning(
+                "staging lane %d failed (%r); re-staging its remaining "
+                "blocks on the consumer thread",
+                li,
+                exc,
+            )
+            return None
+
+    def _run_block_ft(
+        self,
+        session,
+        program: Program,
+        frame: TensorFrame,
+        bi: int,
+        infos,
+        host_stage,
+        pad_to: Optional[int],
+        rows_level: bool,
+        trim: bool,
+        donate: bool,
+        staged,
+        devices: Optional[Sequence[Any]] = None,
+        pool=None,
+        di: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """One map-verb block dispatch under the retry session: attempt 0
+        consumes the prefetched ``staged`` inputs (when they target the
+        effective device), every later attempt RE-STAGES from the host
+        frame — a donated-then-failed buffer is never re-used, and a
+        quarantine redirect lands fresh buffers on the new device.  OOM
+        degrades via :meth:`_oom_split_closure`.  Shared by the serial
+        loop (``devices``/``pool`` None) and the pooled loop.
+
+        Re-staging re-runs any ``host_stage`` fn for the retried block —
+        the same semantics as Spark's lineage replay, which re-executes
+        the whole partition pipeline on task retry and therefore
+        requires deterministic tasks.  The retry contract requires the
+        same of stage fns: deterministic per (block, cells), like the
+        decode fns that motivate ``host_stage``.  A stage fn whose
+        output depends on invocation order cannot participate in block
+        retry (run it with ``TFS_BLOCK_RETRIES=0``, where every error
+        surfaces unretried)."""
+        n_rows = frame.block_sizes[bi]
+        holder = {"staged": staged}
+
+        def attempt(a: int, dev_i: Optional[int]) -> Dict[str, Any]:
+            first = holder.pop("staged", None)  # at most once, ever
+            inputs = first if (a == 0 and (pool is None or dev_i == di)) else None
+            if inputs is None:
+                dev = (
+                    devices[dev_i]
+                    if devices is not None and dev_i is not None
+                    else None
+                )
+                inputs = self._device_inputs(
+                    program, frame.block(bi), infos, host_stage,
+                    pad_to=pad_to, device=dev,
+                )
+            if rows_level:
+                outs = self._rows_run(program, donate)(inputs)
+            elif donate:
+                outs = self._block_run(program, True)(inputs)
+            else:
+                outs = self._run_block_program(program, inputs)
+            del inputs
+            if pad_to is not None:
+                outs = {k: v[:n_rows] for k, v in outs.items()}
+            return outs
+
+        device = (
+            (lambda: pool.effective_device(di))
+            if pool is not None
+            else (0 if di is None else di)  # serial dispatch = device 0
+        )
+        oom_split = self._oom_split_closure(
+            session, program, frame, bi, infos, host_stage, rows_level,
+            trim, devices, pool, di,
+        )
+        return session.run(
+            bi, n_rows, attempt, device=device, oom_split=oom_split
+        )
+
+    def _oom_split_closure(
+        self,
+        session,
+        program: Program,
+        frame: TensorFrame,
+        bi: int,
+        infos,
+        host_stage,
+        rows_level: bool,
+        trim: bool,
+        devices,
+        pool,
+        di,
+    ):
+        """The OOM-degradation policy for one map-verb block: split the
+        block in half and re-dispatch (recursively, floor
+        ``TFS_MIN_SPLIT_ROWS``) when that is provably semantics-safe —
+        ``map_rows`` is row-independent by construction, ``map_blocks``
+        must pass the jaxpr proof at EVERY size the split can reach.
+        Trimmed maps (program-defined output row count), host-staged
+        blocks (one-unit staging contract), and cross-row programs
+        surface a :class:`fault_tolerance.BlockExecutionError` naming
+        the block and row range instead."""
+        n_rows = frame.block_sizes[bi]
+        verb = "map_rows" if rows_level else "map_blocks"
+
+        def refuse(exc: BaseException, why: str):
+            raise fault_tolerance.BlockExecutionError(
+                f"{verb}: block {bi} rows [0, {n_rows}) exhausted device "
+                f"memory and cannot degrade by splitting: {why}"
+            ) from exc
+
+        def split(exc: BaseException) -> Dict[str, Any]:
+            floor = fault_tolerance.min_split_rows()
+            if trim:
+                refuse(exc, "trimmed maps define their own output row "
+                            "count, so half-block outputs cannot be "
+                            "reassembled")
+            if host_stage:
+                refuse(exc, "host-staged blocks stage as one unit")
+            if n_rows < 2 * floor:
+                refuse(
+                    exc,
+                    f"the block is already at the split floor "
+                    f"(TFS_MIN_SPLIT_ROWS={floor})",
+                )
+            if not rows_level:
+                # every size the recursive split can reach, proven
+                # row-independent in one shot (memoized on the program)
+                sizes = set()
+                stack = [(0, n_rows)]
+                while stack:
+                    lo, hi = stack.pop()
+                    sizes.add(hi - lo)
+                    if hi - lo >= 2 * floor:
+                        mid = (lo + hi) // 2
+                        stack += [(lo, mid), (mid, hi)]
+                specs = {
+                    n: jax.ShapeDtypeStruct(
+                        (2,) + tuple(infos[n].cell_shape),
+                        dtypes.coerce(infos[n].scalar_type).np_dtype,
+                    )
+                    for n in program.input_names
+                }
+                if not segment_compile.cached_rows_independent(
+                    program, specs, sorted(sizes)
+                ):
+                    refuse(
+                        exc,
+                        "the program is not provably row-independent "
+                        "(cross-row outputs cannot be recomputed from "
+                        "half blocks)",
+                    )
+            dev_i = (
+                pool.effective_device(di)
+                if pool is not None
+                else (0 if di is None else di)
+            )
+            dev = devices[dev_i] if devices is not None else None
+            mid = n_rows // 2
+            left = self._split_range(
+                session, program, frame, bi, infos, rows_level, 0, mid,
+                dev, dev_i,
+            )
+            right = self._split_range(
+                session, program, frame, bi, infos, rows_level, mid,
+                n_rows, dev, dev_i,
+            )
+            session.note_split(bi)
+            return {
+                k: jnp.concatenate([left[k], right[k]]) for k in left
+            }
+
+        return split
+
+    def _split_range(
+        self,
+        session,
+        program: Program,
+        frame: TensorFrame,
+        bi: int,
+        infos,
+        rows_level: bool,
+        lo: int,
+        hi: int,
+        dev,
+        dev_i: Optional[int],
+    ) -> Dict[str, Any]:
+        """Dispatch rows ``[lo, hi)`` of block ``bi``, splitting again on
+        a further OOM until ``TFS_MIN_SPLIT_ROWS``.  Sub-dispatches use
+        the plain non-donating entries (fresh small buffers; donation
+        would fork another executable per split size for no HBM win) and
+        their injected-fault site is ``"split"`` so attempt-selected
+        specs never re-fire on recovery work."""
+        floor = fault_tolerance.min_split_rows()
+        try:
+            faults.maybe_inject(bi, 0, dev_i, hi - lo, site="split")
+            block = frame.block(bi)
+            sub = {k: v[lo:hi] for k, v in block.items()}
+            inputs = self._device_inputs(
+                program, sub, infos, None, device=dev
+            )
+            if rows_level:
+                return program.vmapped()(inputs)
+            return self._run_block_program(program, inputs)
+        except BaseException as exc:  # noqa: BLE001 - OOM-only recovery
+            if not faults.is_oom(exc):
+                raise
+            if hi - lo < 2 * floor:
+                raise fault_tolerance.BlockExecutionError(
+                    f"block {bi} rows [{lo}, {hi}) exhausted device "
+                    f"memory at the split floor (TFS_MIN_SPLIT_ROWS="
+                    f"{floor}); this row range does not fit on the device"
+                ) from exc
+            mid = (lo + hi) // 2
+            left = self._split_range(
+                session, program, frame, bi, infos, rows_level, lo, mid,
+                dev, dev_i,
+            )
+            right = self._split_range(
+                session, program, frame, bi, infos, rows_level, mid, hi,
+                dev, dev_i,
+            )
+            session.note_split(bi)
+            return {
+                k: jnp.concatenate([left[k], right[k]]) for k in left
+            }
+
     def _map_dispatch_pool(
         self,
         program: Program,
@@ -718,6 +1060,7 @@ class Executor:
         pads: Sequence[Optional[int]],
         donate: bool,
         devices: Sequence[Any],
+        session=None,
     ) -> List[Dict[str, Any]]:
         """Device-pool edition of the map-verb block loop: blocks dispatch
         round-robin/least-loaded across ``devices`` with per-device
@@ -746,6 +1089,8 @@ class Executor:
         assignment = device_pool.assign(sizes, len(devices))
         depth = prefetch.prefetch_depth()
         pool = device_pool.PoolRun(devices, assignment, depth or 1)
+        if session is not None:
+            session.pool = pool  # quarantine state lives on the PoolRun
 
         def stage_block(bi, dev):
             if plans[bi] is not None:
@@ -776,20 +1121,40 @@ class Executor:
             single_iter = None
         chunk_stats = {"items": 0, "stage_s": 0.0, "wait_s": 0.0}
         out_blocks: List[Optional[Dict[str, Any]]] = [None] * nb
+        lane_dead = [False] * (1 if single_iter is not None else len(devices))
         for bi in range(nb):
             di = assignment[bi]
-            staged = (
-                next(single_iter)
-                if single_iter is not None
-                else next(lane_iters[di])
+            li = 0 if single_iter is not None else di
+            it = single_iter if single_iter is not None else lane_iters[di]
+            # the shared host_stage lane stages blocks for EVERY device,
+            # so its death names no particular device — pass pool=None so
+            # no healthy device gets charged a failure it didn't cause
+            staged = self._lane_next(
+                it, lane_dead, li, session,
+                pool if single_iter is None else None,
             )
             n_rows = sizes[bi]
+            di_eff = pool.effective_device(di) if session is not None else di
             if plans[bi] is not None:
+
+                def _resolve(_di=di):
+                    e = pool.effective_device(_di)
+                    return e, devices[e]
+
                 outs = self._run_block_streamed(
                     program, frame.block(bi), infos, plans[bi],
                     rows_level=rows_level, pf_stats=chunk_stats,
-                    device=devices[di],
+                    device=devices[di_eff], bi=bi, session=session,
+                    device_resolver=_resolve if session is not None else None,
                 )
+            elif session is not None:
+                outs = self._run_block_ft(
+                    session, program, frame, bi, infos, host_stage,
+                    pads[bi], rows_level, trim, donate, staged,
+                    devices=devices, pool=pool, di=di,
+                )
+                del staged
+                di_eff = pool.effective_device(di)
             else:
                 if rows_level:
                     outs = self._rows_run(program, donate)(staged)
@@ -801,7 +1166,7 @@ class Executor:
                 if pads[bi] is not None:
                     outs = {k: v[:n_rows] for k, v in outs.items()}
             self._check_block_outputs(program, outs, n_rows, rows_level, trim)
-            pool.submit(bi, di, n_rows, outs, out_blocks)
+            pool.submit(bi, di_eff, n_rows, outs, out_blocks)
         pool.finish(out_blocks)
         staged_blocks = sum(1 for p in plans if p is None)
         stage_s = (
@@ -824,6 +1189,8 @@ class Executor:
                 "donate": donate,
             },
         )
+        if session is not None and session.events():
+            span.annotate("fault_tolerance", session.record())
         return out_blocks
 
     def _empty_map_outputs(
@@ -1436,6 +1803,9 @@ class Executor:
         sizes = frame.block_sizes
         nonempty = [bi for bi in range(frame.num_blocks) if sizes[bi] > 0]
         sts = {b: dtypes.coerce(reduced[b].scalar_type) for b in bases}
+        session = fault_tolerance.frame_session(
+            frame.num_blocks, verb="reduce"
+        )
         pool_devs = (
             device_pool.pool_devices()
             if (
@@ -1448,11 +1818,25 @@ class Executor:
         if len(pool_devs) < 2:
             partials: List[Dict[str, jnp.ndarray]] = []
             for bi in nonempty:
-                block = frame.block(bi)
-                arrays = {
-                    b: self._device_value(block[b], sts[b]) for b in bases
-                }
-                partials.append(run(arrays))
+
+                def attempt(a, dev_i, _bi=bi):
+                    block = frame.block(_bi)
+                    arrays = {
+                        b: self._device_value(block[b], sts[b])
+                        for b in bases
+                    }
+                    return run(arrays)
+
+                if session is None:
+                    partials.append(attempt(0, None))
+                else:
+                    # reduce partials are cross-row by definition: no OOM
+                    # split — an OOM surfaces with the block's row range
+                    partials.append(
+                        session.run(bi, sizes[bi], attempt, device=0)
+                    )
+            if session is not None and session.events():
+                span.annotate("fault_tolerance", session.record())
             span.mark("dispatch_partials")
             return partials
         assignment = device_pool.assign(
@@ -1461,6 +1845,8 @@ class Executor:
         pool = device_pool.PoolRun(
             pool_devs, assignment, prefetch.prefetch_depth() or 1
         )
+        if session is not None:
+            session.pool = pool
 
         def stage_block(k, dev):
             block = frame.block(nonempty[k])
@@ -1471,13 +1857,41 @@ class Executor:
 
         lanes = device_pool.lanes(pool_devs, assignment, stage_block)
         lane_iters = [iter(l) for l in lanes]
+        lane_dead = [False] * len(pool_devs)
         combine = pool_devs[0]
         partials = []
         for k, bi in enumerate(nonempty):
             di = assignment[k]
-            arrays = next(lane_iters[di])
-            p = run(arrays)
-            pool.note_dispatch(di, sizes[bi])
+            if session is None:
+                arrays = next(lane_iters[di])
+                p = run(arrays)
+                di_eff = di
+            else:
+                staged = self._lane_next(
+                    lane_iters[di], lane_dead, di, session, pool
+                )
+                holder = {"v": staged}
+                del staged
+
+                def attempt(a, dev_i, _k=k, _h=holder, _di=di):
+                    arrs = (
+                        _h.pop("v", None)
+                        if (a == 0 and dev_i == _di)
+                        else None
+                    )
+                    _h.clear()
+                    if arrs is None:
+                        arrs = stage_block(_k, pool_devs[dev_i])
+                    return run(arrs)
+
+                p = session.run(
+                    bi,
+                    sizes[bi],
+                    attempt,
+                    device=lambda _di=di: pool.effective_device(_di),
+                )
+                di_eff = pool.effective_device(di)
+            pool.note_dispatch(di_eff, sizes[bi])
             # async hop to the combine device: one reduced cell per base
             partials.append(
                 {b: jax.device_put(p[b], combine) for b in bases}
@@ -1489,6 +1903,8 @@ class Executor:
                 sum(l.stats["wait_s"] for l in lanes),
             ),
         )
+        if session is not None and session.events():
+            span.annotate("fault_tolerance", session.record())
         span.mark("dispatch_partials")
         return partials
 

@@ -1,0 +1,265 @@
+"""Block-level fault tolerance: per-block retry, quarantine, OOM policy.
+
+The reference recovers at the *partition*: a failed Spark task replays
+its partition from RDD lineage (SURVEY.md §5) and a flaky executor gets
+blacklisted by the scheduler.  Our data plane's unit of work is the
+block, and there is no lineage — the source block is still on the host,
+so recovery is re-dispatch.  This module is the policy layer the
+execution stack (``engine.py``, ``device_pool.py``, ``pipeline.py``)
+threads through every block dispatch:
+
+* **per-block retry** (:class:`FrameRetrySession`): a transient failure
+  (classified by the SAME ``resilience.FailureDetector`` the step driver
+  uses — one classifier, no drift) re-stages and re-dispatches the block
+  with exponential backoff.  Two budgets bound it: ``TFS_BLOCK_RETRIES``
+  retries per block, and a per-frame total (retries x blocks) metered by
+  the shared detector, so a frame-wide brownout cannot retry forever.
+  Exhaustion raises ``RestartBudgetExceeded`` carrying the LAST real
+  error (``from exc``), never a bare budget message.
+* **device quarantine**: pooled dispatches report transient failures to
+  their :class:`~tensorframes_tpu.ops.device_pool.PoolRun`; after
+  ``TFS_QUARANTINE_AFTER`` failures a device is drained — its remaining
+  blocks re-dispatch to the least-loaded healthy device.  Reassembly is
+  by block index, so redirection cannot change results; a pool degraded
+  to one healthy device is, by construction, the serial path on that
+  device.
+* **OOM degradation**: a ``RESOURCE_EXHAUSTED`` on a map-verb block
+  whose program passes the jaxpr row-independence proof splits the block
+  in half recursively (floor ``TFS_MIN_SPLIT_ROWS``) and re-dispatches
+  the halves — row independence makes the concatenated halves
+  bit-identical to the whole-block dispatch.  Cross-row programs (and
+  trimmed / host-staged blocks) surface a
+  :class:`BlockExecutionError` naming the block and row range instead.
+
+The retry contract: **retries never change results.**  Every re-dispatch
+re-stages fresh buffers from the host frame (a donated-then-failed
+buffer is never re-used — the no-use-after-donate rule survives
+failures), runs the same executable, and lands in the same block slot.
+Tests pin ``TFS_BLOCK_RETRIES=0`` (conftest) so trace-count fences stay
+deterministic; the chaos tier turns the knobs on.
+
+Knobs:
+
+* ``TFS_BLOCK_RETRIES`` — retries per block (default 2; 0 disables the
+  whole layer unless fault injection is active).
+* ``TFS_BLOCK_BACKOFF_S`` — base backoff between block retries
+  (default 0.05; block retries are cheap re-dispatches, not process
+  restarts, so the base is far below ``FailureDetector``'s 1 s default).
+* ``TFS_MIN_SPLIT_ROWS`` — OOM split floor (default 16): a range
+  smaller than twice the floor never splits further.
+* ``TFS_QUARANTINE_AFTER`` — transient failures before a pool device is
+  drained (default 3).
+* ``TFS_FAULT_INJECT`` — the deterministic fault-injection plan
+  (``tensorframes_tpu/faults.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Callable, Optional, Tuple
+
+from .. import faults, observability, resilience
+
+logger = logging.getLogger("tensorframes_tpu.fault_tolerance")
+
+ENV_RETRIES = "TFS_BLOCK_RETRIES"
+ENV_BACKOFF = "TFS_BLOCK_BACKOFF_S"
+ENV_MIN_SPLIT = "TFS_MIN_SPLIT_ROWS"
+ENV_QUARANTINE = "TFS_QUARANTINE_AFTER"
+
+DEFAULT_RETRIES = 2
+DEFAULT_BACKOFF_S = 0.05
+DEFAULT_MIN_SPLIT_ROWS = 16
+DEFAULT_QUARANTINE_AFTER = 3
+
+
+def _env_int(name: str, default: int, floor: int = 0) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return max(floor, int(raw))
+    except ValueError:
+        return default
+
+
+def block_retries() -> int:
+    """Retries per block dispatch (``TFS_BLOCK_RETRIES``, >= 0)."""
+    return _env_int(ENV_RETRIES, DEFAULT_RETRIES)
+
+
+def block_backoff_s() -> float:
+    """Base backoff between block retries (``TFS_BLOCK_BACKOFF_S``)."""
+    try:
+        return max(0.0, float(os.environ.get(ENV_BACKOFF, "")))
+    except ValueError:
+        return DEFAULT_BACKOFF_S
+
+
+def min_split_rows() -> int:
+    """OOM-degradation split floor (``TFS_MIN_SPLIT_ROWS``, >= 1)."""
+    return _env_int(ENV_MIN_SPLIT, DEFAULT_MIN_SPLIT_ROWS, floor=1)
+
+
+def quarantine_after() -> int:
+    """Transient failures before a pool device drains
+    (``TFS_QUARANTINE_AFTER``, >= 1)."""
+    return _env_int(ENV_QUARANTINE, DEFAULT_QUARANTINE_AFTER, floor=1)
+
+
+class BlockExecutionError(RuntimeError):
+    """A block's dispatch failed irrecoverably; the message names the
+    block index and row range so a frame-scale failure points at data."""
+
+
+def frame_session(
+    num_blocks: int, verb: str = "", pool=None
+) -> Optional["FrameRetrySession"]:
+    """A :class:`FrameRetrySession` for one verb invocation, or ``None``
+    when the layer is fully off (``TFS_BLOCK_RETRIES=0`` and no fault
+    injection) — the None fast path keeps the default dispatch loops
+    byte-for-byte identical to the pre-round-9 engine, which is what the
+    suite's trace/compile fences pin."""
+    retries = block_retries()
+    if retries <= 0 and not faults.active():
+        return None
+    return FrameRetrySession(num_blocks, retries, verb=verb, pool=pool)
+
+
+class FrameRetrySession:
+    """One verb invocation's retry bookkeeping: the per-block attempt
+    loop, the shared per-frame detector budget, quarantine reporting,
+    and the counters the verb span records."""
+
+    def __init__(
+        self,
+        num_blocks: int,
+        retries: Optional[int] = None,
+        verb: str = "",
+        pool=None,
+        detector: Optional[resilience.FailureDetector] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.per_block = block_retries() if retries is None else int(retries)
+        self.verb = verb
+        self.pool = pool
+        # ONE detector per frame: classification lives in resilience (no
+        # duplicated tables) and its restart budget is the frame-level
+        # bound — per_block retries for every block is the ceiling
+        self.detector = detector or resilience.FailureDetector(
+            max_restarts=max(self.per_block, 1) * max(num_blocks, 1),
+            backoff_s=block_backoff_s(),
+        )
+        self._sleep = sleep
+        self.retries = 0
+        self.oom_splits = 0
+
+    # -- per-block loop ------------------------------------------------------
+
+    def run(
+        self,
+        bi: int,
+        n_rows: int,
+        attempt_fn: Callable[[int, Optional[int]], Any],
+        device=None,
+        oom_split: Optional[Callable[[BaseException], Any]] = None,
+        row_range: Optional[Tuple[int, int]] = None,
+    ):
+        """Run ``attempt_fn(attempt, device_index)`` for block ``bi``
+        with injection, classification, backoff, and budgets applied.
+
+        ``attempt_fn`` MUST re-stage its inputs on every attempt past the
+        first (the donation-safety half of the retry contract: a buffer
+        handed to a donating executable is dead whether the dispatch
+        succeeded or not).  ``device`` is an int pool-device index, a
+        zero-arg callable returning the current effective index (the
+        quarantine-aware pools pass this), or None.  ``oom_split`` is the
+        verb's degradation closure: called with the OOM exception, it
+        either returns the block's outputs computed from split
+        sub-ranges or raises :class:`BlockExecutionError`.
+        """
+        lo, hi = row_range if row_range is not None else (0, n_rows)
+        attempt = 0
+        while True:
+            dev_i = device() if callable(device) else device
+            try:
+                faults.maybe_inject(bi, attempt, dev_i, n_rows)
+                return attempt_fn(attempt, dev_i)
+            except BaseException as exc:  # noqa: BLE001 - classified below
+                if faults.is_oom(exc):
+                    if oom_split is not None:
+                        return oom_split(exc)
+                    raise BlockExecutionError(
+                        f"{self.verb}: block {bi} rows [{lo}, {hi}) "
+                        f"exhausted device memory and this dispatch "
+                        f"cannot degrade by splitting ({exc})"
+                    ) from exc
+                if not self.detector.is_transient(exc):
+                    raise
+                if self.pool is not None and dev_i is not None:
+                    # quarantine decisions must see every failure,
+                    # including the one that exhausts the budget
+                    self.pool.note_block_failure(dev_i)
+                if attempt >= self.per_block:
+                    if self.per_block <= 0:
+                        raise  # retries pinned off: surface untouched
+                    raise resilience.RestartBudgetExceeded(
+                        f"{self.verb}: block {bi} rows [{lo}, {hi}) failed "
+                        f"{attempt + 1} times ({ENV_RETRIES}="
+                        f"{self.per_block}); last error: {exc!r}"
+                    ) from exc
+                delay = self.detector.on_failure(exc)
+                # the detector's exponent grows with FRAME-cumulative
+                # restarts (right for one restarted step, wrong for many
+                # independent blocks: unrelated blocks would inherit each
+                # other's backoff).  Bound the sleep by the BLOCK's own
+                # attempt index — per-task backoff, Spark-style — while
+                # the detector keeps metering the frame budget.
+                delay = min(
+                    delay,
+                    self.detector.backoff_s
+                    * self.detector.backoff_factor ** attempt,
+                )
+                self.retries += 1
+                observability.note_block_retry()
+                logger.warning(
+                    "%s: block %d (device %s) transient failure, retry "
+                    "%d/%d after %.3fs: %r",
+                    self.verb,
+                    bi,
+                    dev_i,
+                    attempt + 1,
+                    self.per_block,
+                    delay,
+                    exc,
+                )
+                self._sleep(delay)
+                attempt += 1
+
+    # -- accounting ----------------------------------------------------------
+
+    def note_split(self, bi: int) -> None:
+        """One binary OOM split performed for block ``bi``."""
+        self.oom_splits += 1
+        observability.note_oom_split()
+
+    def events(self) -> bool:
+        """Whether anything recovery-worthy happened (gates the span
+        annotation so fault-free spans keep their exact prior shape)."""
+        return bool(
+            self.retries
+            or self.oom_splits
+            or (self.pool is not None and self.pool.quarantined)
+        )
+
+    def record(self) -> dict:
+        """The ``fault_tolerance`` span annotation."""
+        rec: dict = {
+            "retries": self.retries,
+            "oom_splits": self.oom_splits,
+            "retry_budget_per_block": self.per_block,
+        }
+        if self.pool is not None:
+            rec["failures_per_device"] = list(self.pool.failures)
+            rec["quarantined_devices"] = sorted(self.pool.quarantined)
+        return rec

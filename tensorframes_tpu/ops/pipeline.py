@@ -65,7 +65,14 @@ from ..frame import TensorFrame, is_device_array
 from ..program import Program
 from ..schema import ColumnInfo, Schema
 from ..shape import Shape, UNKNOWN
-from . import bucketing, device_pool, prefetch, segment_compile, validation
+from . import (
+    bucketing,
+    device_pool,
+    fault_tolerance,
+    prefetch,
+    segment_compile,
+    validation,
+)
 from .engine import _DEFAULT
 from .validation import ValidationError
 
@@ -747,6 +754,14 @@ class Pipeline:
             pool = device_pool.PoolRun(
                 devices, assignment, prefetch.prefetch_depth() or 1
             )
+            # block-level fault tolerance (ops/fault_tolerance.py): the
+            # pooled per-block chain retries exactly like the eager map
+            # verbs — re-staged entry buffers, quarantine redirects, by-
+            # index reassembly.  None (the default) keeps this loop
+            # byte-identical to the retry-free round-8 path.
+            session = fault_tolerance.frame_session(
+                nb, verb="pipeline", pool=pool
+            )
             offsets = frame.offsets
             host_cols = {
                 name: np.asarray(data) if not is_device_array(data) else data
@@ -769,18 +784,50 @@ class Pipeline:
 
             lanes = device_pool.lanes(devices, assignment, stage_block)
             lane_iters = [iter(l) for l in lanes]
+            lane_dead = [False] * len(devices)
             params_list = self._params_list()
             out_blocks: List[Optional[Dict[str, Any]]] = [None] * nb
             for bi in range(nb):
                 di = assignment[bi]
-                staged = next(lane_iters[di])
-                outs = run(staged, params_list)
-                del staged
+                if session is None:
+                    staged = next(lane_iters[di])
+                    outs = run(staged, params_list)
+                    del staged
+                    di_eff = di
+                else:
+                    staged = _DEFAULT._lane_next(
+                        lane_iters[di], lane_dead, di, session, pool
+                    )
+                    holder = {"v": staged}
+                    del staged
+
+                    def attempt(a, dev_i, _bi=bi, _h=holder, _di=di):
+                        # attempt 0 may consume the lane-staged entry
+                        # buffers; every retry (and any quarantine
+                        # redirect) re-stages fresh host slices — a
+                        # donated-then-failed buffer is never re-used
+                        ins = (
+                            _h.pop("v", None)
+                            if (a == 0 and dev_i == _di)
+                            else None
+                        )
+                        _h.clear()
+                        if ins is None:
+                            ins = stage_block(_bi, devices[dev_i])
+                        return run(ins, params_list)
+
+                    outs = session.run(
+                        bi,
+                        sizes[bi],
+                        attempt,
+                        device=lambda _di=di: pool.effective_device(_di),
+                    )
+                    di_eff = pool.effective_device(di)
                 if pads[bi] is not None:
                     # bucket-padded chain: slice the pad rows back off
                     # (the _pool_pads proof guarantees real rows' values)
                     outs = {k: v[: sizes[bi]] for k, v in outs.items()}
-                pool.submit(bi, di, sizes[bi], outs, out_blocks)
+                pool.submit(bi, di_eff, sizes[bi], outs, out_blocks)
             pool.finish(out_blocks)
             span.annotate(
                 "device_pool",
@@ -789,6 +836,8 @@ class Pipeline:
                     sum(l.stats["wait_s"] for l in lanes),
                 ),
             )
+            if session is not None and session.events():
+                span.annotate("fault_tolerance", session.record())
             span.mark("dispatch")
             out_frame = TensorFrame.from_blocks(out_blocks)
             # host-only / ragged source columns pass through unchanged when

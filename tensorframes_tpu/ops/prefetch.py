@@ -75,6 +75,19 @@ import numpy as np
 
 DEFAULT_DEPTH = 2
 
+
+class StagingError(RuntimeError):
+    """A prefetch worker's staging callable failed.
+
+    The message names the failing item index and the lane
+    (``raise ... from e`` keeps the original as ``__cause__``), so a
+    mid-stream staging failure points at a BLOCK instead of surfacing
+    as a bare queue-crossed exception with no context.  Program-contract
+    errors (``ValidationError``) pass through unwrapped — they already
+    carry their own diagnosis and callers assert on their type.
+    ``resilience.FailureDetector`` classifies a StagingError by walking
+    its cause, so a transient transfer failure stays retryable."""
+
 # backends whose PJRT client implements input-buffer donation; elsewhere
 # jax warns ("Some donated buffers were not usable") and copies instead
 _DONATING_BACKENDS = ("tpu", "gpu", "cuda", "rocm")
@@ -176,6 +189,7 @@ class Prefetcher:
         stop = threading.Event()
 
         def worker():
+            i = -1
             try:
                 for i in range(self._n):
                     if stop.is_set():
@@ -189,10 +203,12 @@ class Prefetcher:
                             break
                         except queue.Full:
                             continue
-            except BaseException as e:  # propagate to the consumer
+            except BaseException as e:  # propagate to the consumer,
+                # tagged with the failing item so the consumer can
+                # re-raise with block context (StagingError)
                 while not stop.is_set():
                     try:
-                        q.put((None, e), timeout=0.1)
+                        q.put((None, (i, e)), timeout=0.1)
                         break
                     except queue.Full:
                         continue
@@ -207,7 +223,17 @@ class Prefetcher:
                 v, err = q.get()
                 self.stats["wait_s"] += time.perf_counter() - t0
                 if err is not None:
-                    raise err
+                    i, e = err
+                    from .validation import ValidationError
+
+                    if isinstance(e, ValidationError):
+                        # program-contract errors keep their type (the
+                        # verb API's documented error surface)
+                        raise e
+                    raise StagingError(
+                        f"{self._name}: staging block {i} failed: "
+                        f"{type(e).__name__}: {e}"
+                    ) from e
                 yield v
         finally:
             stop.set()

@@ -26,6 +26,7 @@ the driver only needs to rebuild its mesh from the surviving
 from __future__ import annotations
 
 import logging
+import random
 import time
 from typing import Any, Callable, Optional, Tuple
 
@@ -95,19 +96,31 @@ class FailureDetector:
         max_restarts: int = 3,
         backoff_s: float = 1.0,
         backoff_factor: float = 2.0,
+        jitter: float = 0.0,
+        rng: Optional[random.Random] = None,
     ):
         self.max_restarts = max_restarts
         self.backoff_s = backoff_s
         self.backoff_factor = backoff_factor
+        # decorrelated jitter (round 9): 0.0 keeps the exact exponential
+        # sequence (existing callers/tests unchanged); 1.0 is the classic
+        # uniform(base, 3*prev) rule, values between scale the random
+        # span.  ``rng`` is injectable so jittered tests stay exact.
+        self.jitter = float(jitter)
+        self._rng = rng if rng is not None else random.Random()
+        self._prev_delay = backoff_s
         self.restarts = 0
 
-    def is_transient(self, exc: BaseException) -> bool:
+    def is_transient(self, exc: BaseException, _depth: int = 0) -> bool:
         """Type-first classification (ADVICE r2): fatal program-error types
         never retry; network-loss types always do; everything else —
         including ``JaxRuntimeError`` — retries only when the message shows
         runtime-failure context (preemption/halt/collective/...), so XLA
         INTERNAL compiler bugs surface immediately instead of burning the
-        restart budget."""
+        restart budget.  An inconclusive exception with an explicit
+        ``raise ... from`` cause defers to the cause's classification
+        (bounded walk), so a wrapped staging/transfer failure keeps its
+        underlying transience."""
         if isinstance(exc, _FATAL_TYPES):
             return False
         if isinstance(exc, _TRANSIENT_TYPES):
@@ -116,7 +129,11 @@ class FailureDetector:
             if str(exc).lower().lstrip().startswith(_TRANSIENT_XLA_STATUS):
                 return True
         text = f"{type(exc).__name__}: {exc}".lower()
-        return any(m in text for m in _TRANSIENT_MARKERS)
+        if any(m in text for m in _TRANSIENT_MARKERS):
+            return True
+        if _depth < 4 and exc.__cause__ is not None:
+            return self.is_transient(exc.__cause__, _depth + 1)
+        return False
 
     def on_failure(self, exc: BaseException) -> float:
         """Record a failure; returns the backoff to sleep, or raises."""
@@ -129,6 +146,21 @@ class FailureDetector:
                 f"step failed {self.restarts} times; last error: {exc!r}"
             ) from exc
         delay = self.backoff_s * self.backoff_factor ** (self.restarts - 1)
+        if self.jitter > 0.0:
+            # decorrelated jitter: draw uniform(base, hi) where hi grows
+            # with the PREVIOUS delay (3x rule), scaled by ``jitter``;
+            # capped at the un-jittered exponential ceiling so a lucky
+            # streak cannot exceed the deterministic worst case
+            hi = self.backoff_s + (
+                3.0 * self._prev_delay - self.backoff_s
+            ) * self.jitter
+            delay = self._rng.uniform(self.backoff_s, max(self.backoff_s, hi))
+            delay = min(
+                delay,
+                self.backoff_s
+                * self.backoff_factor ** max(self.max_restarts - 1, 0),
+            )
+        self._prev_delay = delay
         _log.warning(
             "transient failure (%s); restart %d/%d after %.1fs",
             exc,

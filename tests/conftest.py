@@ -25,6 +25,15 @@ if "xla_force_host_platform_device_count" not in _flags:
 # the pool explicitly per test, and run process-isolated below.
 os.environ.setdefault("TFS_DEVICE_POOL", "0")
 
+# Block-level fault tolerance (ops/fault_tolerance.py) stays OFF in the
+# main suite: retries re-dispatch blocks (extra traces would break the
+# trace/compile-count fences) and fault injection is chaos by design.
+# The fault-tolerance tests (tests/test_fault_tolerance.py) re-enable
+# both explicitly per test; run_tests.sh's chaos tier runs them under
+# TFS_FAULT_INJECT matrices.
+os.environ.setdefault("TFS_BLOCK_RETRIES", "0")
+os.environ.setdefault("TFS_FAULT_INJECT", "")
+
 import jax  # noqa: E402
 
 # The axon environment's sitecustomize force-registers the TPU backend and

@@ -1,0 +1,720 @@
+"""Block-level fault tolerance (``ops/fault_tolerance.py`` +
+``faults.py``).
+
+The contract under test is the round-9 resilience invariant: **retries
+never change results** — whatever faults are injected, a verb either
+returns exactly the fault-free bytes or surfaces an error naming the
+block (and row range) that failed.  The fault schedules are
+deterministic by construction (``TFS_FAULT_INJECT`` draws are hashed
+from (seed, block, attempt)), so every test here is exactly
+reproducible: a failure is a recovery bug, never flakiness.
+
+Tests named ``test_pooled_*`` run process-isolated on the forced
+8-device mesh (tests/conftest.py), like the device-pool suite.  The
+chaos-marked tests also honor ``TFS_CHAOS_RATE``/``TFS_CHAOS_SEED`` so
+``run_tests.sh``'s chaos tier can sweep an injection matrix over them.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import faults, observability as obs
+from tensorframes_tpu.ops import engine, fault_tolerance
+from tensorframes_tpu.ops.pipeline import pipeline
+from tensorframes_tpu.resilience import (
+    FailureDetector,
+    RestartBudgetExceeded,
+)
+
+
+def _frame(n=80, nb=4, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return tfs.analyze(
+        tfs.TensorFrame.from_arrays(
+            {
+                "x": rng.rand(n, d).astype(np.float32),
+                "k": (np.arange(n) % 5).astype(np.int32),
+            },
+            num_blocks=nb,
+        )
+    )
+
+
+def _retry_env(monkeypatch, retries="2", inject=""):
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", retries)
+    monkeypatch.setenv("TFS_BLOCK_BACKOFF_S", "0.001")
+    monkeypatch.setenv("TFS_FAULT_INJECT", inject)
+
+
+# ---------------------------------------------------------------------------
+# spec parsing / injection plumbing (no dispatch)
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parsing(monkeypatch):
+    monkeypatch.setenv(
+        "TFS_FAULT_INJECT",
+        "transient:block=3:attempt=0;oom:device=1:rate=0.25:seed=7;"
+        "delay:ms=5",
+    )
+    specs = faults.specs()
+    assert [s.kind for s in specs] == ["transient", "oom", "delay"]
+    assert specs[0].block == 3 and specs[0].attempt == 0
+    assert specs[1].device == 1 and specs[1].rate == 0.25
+    assert specs[1].seed == 7
+    assert specs[2].ms == 5.0
+    assert faults.active()
+    monkeypatch.setenv("TFS_FAULT_INJECT", "")
+    assert not faults.active()
+
+
+def test_fault_spec_malformed_ignored(monkeypatch):
+    monkeypatch.setenv(
+        "TFS_FAULT_INJECT", "banana:block=1;transient:block=2;oom:frobs=3"
+    )
+    specs = faults.specs()
+    # unknown kind and unknown selector are dropped with a warning; the
+    # valid spec survives
+    assert [s.kind for s in specs] == ["transient"]
+    assert specs[0].block == 2
+
+
+def test_rate_draws_deterministic(monkeypatch):
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:rate=0.5:seed=3")
+    (spec,) = faults.specs()
+    draws1 = [
+        spec.matches(bi, 0, None, 10, "dispatch") for bi in range(64)
+    ]
+    draws2 = [
+        spec.matches(bi, 0, None, 10, "dispatch") for bi in range(64)
+    ]
+    assert draws1 == draws2  # same (seed, block, attempt) -> same draw
+    assert any(draws1) and not all(draws1)  # a real Bernoulli, not 0/1
+
+
+def test_injected_exceptions_classify(monkeypatch):
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:block=0")
+    with pytest.raises(faults.InjectedTransient) as ei:
+        faults.maybe_inject(0, 0, None, 10)
+    assert FailureDetector().is_transient(ei.value)
+    assert not faults.is_oom(ei.value)
+    monkeypatch.setenv("TFS_FAULT_INJECT", "oom:block=0")
+    with pytest.raises(faults.InjectedOOM) as ei:
+        faults.maybe_inject(0, 0, None, 10)
+    assert faults.is_oom(ei.value)
+    assert not FailureDetector().is_transient(ei.value)
+
+
+def test_attempt_selector_skips_split_site(monkeypatch):
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:block=1:attempt=0")
+    with pytest.raises(faults.InjectedTransient):
+        faults.maybe_inject(1, 0, None, 10, site="dispatch")
+    # recovery sub-dispatches are not fresh attempts
+    faults.maybe_inject(1, 0, None, 10, site="split")
+
+
+# ---------------------------------------------------------------------------
+# FrameRetrySession unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_session_retries_transient_then_succeeds():
+    session = fault_tolerance.FrameRetrySession(
+        4, retries=2, verb="t", sleep=lambda _: None
+    )
+    calls = []
+
+    def attempt(a, dev_i):
+        calls.append(a)
+        if a == 0:
+            raise RuntimeError("UNAVAILABLE: flaky link")
+        return {"ok": a}
+
+    out = session.run(0, 10, attempt)
+    assert out == {"ok": 1}
+    assert calls == [0, 1]
+    assert session.retries == 1
+    assert session.events()
+    assert session.record()["retries"] == 1
+
+
+def test_session_fatal_not_retried():
+    session = fault_tolerance.FrameRetrySession(
+        4, retries=3, verb="t", sleep=lambda _: None
+    )
+    calls = []
+
+    def attempt(a, dev_i):
+        calls.append(a)
+        raise ValueError("deterministic program bug")
+
+    with pytest.raises(ValueError, match="deterministic"):
+        session.run(0, 10, attempt)
+    assert calls == [0]
+    assert session.retries == 0
+
+
+def test_session_budget_exhaustion_keeps_last_error():
+    session = fault_tolerance.FrameRetrySession(
+        4, retries=2, verb="t", sleep=lambda _: None
+    )
+
+    def attempt(a, dev_i):
+        raise RuntimeError(f"UNAVAILABLE: persistent outage (try {a})")
+
+    with pytest.raises(RestartBudgetExceeded) as ei:
+        session.run(3, 10, attempt)
+    # the surfaced error names the block AND carries the last real error
+    assert "block 3" in str(ei.value)
+    assert "try 2" in str(ei.value)
+    assert isinstance(ei.value.__cause__, RuntimeError)
+
+
+def test_session_oom_without_split_names_rows():
+    session = fault_tolerance.FrameRetrySession(
+        2, retries=2, verb="reduce", sleep=lambda _: None
+    )
+
+    def attempt(a, dev_i):
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    with pytest.raises(
+        fault_tolerance.BlockExecutionError, match=r"block 1 rows \[5, 25\)"
+    ):
+        session.run(1, 20, attempt, row_range=(5, 25))
+
+
+def test_session_none_when_disabled(monkeypatch):
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", "0")
+    monkeypatch.setenv("TFS_FAULT_INJECT", "")
+    assert fault_tolerance.frame_session(4) is None
+    # fault injection alone brings the layer up (so specs fire even with
+    # retries pinned off)
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:block=0")
+    assert fault_tolerance.frame_session(4) is not None
+    monkeypatch.setenv("TFS_FAULT_INJECT", "")
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", "1")
+    assert fault_tolerance.frame_session(4) is not None
+
+
+# ---------------------------------------------------------------------------
+# serial engine: retry, budget, OOM degradation
+# ---------------------------------------------------------------------------
+
+
+def test_transient_block_fault_retried_bit_identical(monkeypatch):
+    frame = _frame()
+    prog = tfs.Program.wrap(
+        lambda x: {"y": jnp.tanh(x) * 2.0 + x}, fetches=["y"]
+    )
+    _retry_env(monkeypatch)
+    base = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    _retry_env(monkeypatch, inject="transient:block=2:attempt=0")
+    obs.enable()
+    try:
+        c0 = obs.counters()
+        got = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+        d = obs.counters_delta(c0)
+        span = obs.last_spans(1)[0]
+    finally:
+        obs.disable()
+    np.testing.assert_array_equal(base, got)
+    assert d["block_retries"] == 1
+    assert d["faults_injected"] == 1
+    assert span["fault_tolerance"]["retries"] == 1
+
+
+def test_retries_pinned_off_surface_raw_fault(monkeypatch):
+    frame = _frame()
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0}, fetches=["y"])
+    _retry_env(monkeypatch, retries="0",
+               inject="transient:block=1:attempt=0")
+    with pytest.raises(faults.InjectedTransient, match="block=1"):
+        tfs.map_blocks(prog, frame)
+
+
+def test_retry_budget_exhaustion_surfaces_last_error(monkeypatch):
+    frame = _frame()
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0}, fetches=["y"])
+    _retry_env(monkeypatch, inject="transient:block=1")  # never recovers
+    with pytest.raises(RestartBudgetExceeded) as ei:
+        tfs.map_blocks(prog, frame)
+    assert "block 1" in str(ei.value)
+    assert isinstance(ei.value.__cause__, faults.InjectedTransient)
+
+
+def test_map_rows_and_reduce_verbs_retry_bit_identical(monkeypatch):
+    frame = _frame(n=100, nb=5)
+    mapr = tfs.Program.wrap(lambda x: {"r": x.sum() + x[0]}, fetches=["r"])
+    pair = tfs.Program.wrap(
+        lambda x_1, x_2: {"x": x_1 * 0.9 + 3.0 * x_2}, fetches=["x"]
+    )
+    blockred = tfs.Program.wrap(
+        lambda x_input: {"x": (x_input * 1.3).sum(0)}, fetches=["x"]
+    )
+    _retry_env(monkeypatch)
+    base = {
+        "map_rows": np.asarray(
+            tfs.map_rows(mapr, frame).column("r").data
+        ),
+        "reduce_rows": tfs.reduce_rows(pair, frame, mode="sequential")["x"],
+        "reduce_blocks": tfs.reduce_blocks(blockred, frame)["x"],
+    }
+    _retry_env(monkeypatch, inject="transient:block=3:attempt=0")
+    got = {
+        "map_rows": np.asarray(
+            tfs.map_rows(mapr, frame).column("r").data
+        ),
+        "reduce_rows": tfs.reduce_rows(pair, frame, mode="sequential")["x"],
+        "reduce_blocks": tfs.reduce_blocks(blockred, frame)["x"],
+    }
+    for k in base:
+        np.testing.assert_array_equal(base[k], got[k], err_msg=k)
+
+
+def test_streamed_chunk_retry_bit_identical(monkeypatch):
+    rng = np.random.RandomState(1)
+    arrs = {"x": rng.rand(1024, 8).astype(np.float32)}
+    prog = tfs.Program.wrap(lambda x: {"y": x * 3.0}, fetches=["y"])
+
+    def run():
+        frame = tfs.analyze(
+            tfs.TensorFrame.from_arrays(arrs, num_blocks=2)
+        )
+        return np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+
+    _retry_env(monkeypatch)
+    base = run()
+    monkeypatch.setattr(engine.Executor, "stream_chunk_bytes", 4096)
+    monkeypatch.setenv("TFS_PREFETCH_BLOCKS", "2")
+    _retry_env(monkeypatch, inject="transient:block=1:attempt=0")
+    np.testing.assert_array_equal(base, run())
+
+
+def test_delay_spec_is_harmless(monkeypatch):
+    frame = _frame()
+    prog = tfs.Program.wrap(lambda x: {"y": x + 1.0}, fetches=["y"])
+    _retry_env(monkeypatch)
+    base = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    _retry_env(monkeypatch, inject="delay:ms=2")
+    got = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    np.testing.assert_array_equal(base, got)
+
+
+# ---------------------------------------------------------------------------
+# OOM graceful degradation
+# ---------------------------------------------------------------------------
+
+
+def test_oom_split_recursion_bit_identical(monkeypatch):
+    frame = _frame(n=80, nb=4)  # 20-row blocks
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0 + 1.0}, fetches=["y"])
+    _retry_env(monkeypatch)
+    base = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    monkeypatch.setenv("TFS_MIN_SPLIT_ROWS", "4")
+    # full block (20 rows) and its halves (10) OOM; quarters (5) fit
+    _retry_env(monkeypatch, inject="oom:block=0:minrows=10")
+    obs.enable()
+    try:
+        c0 = obs.counters()
+        got = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+        d = obs.counters_delta(c0)
+        span = obs.last_spans(1)[0]
+    finally:
+        obs.disable()
+    np.testing.assert_array_equal(base, got)
+    assert d["block_oom_splits"] == 3  # root split + one per half
+    assert span["fault_tolerance"]["oom_splits"] == 3
+
+
+def test_oom_split_map_rows_bit_identical(monkeypatch):
+    frame = _frame(n=80, nb=4)
+    prog = tfs.Program.wrap(lambda x: {"r": x.sum() * 0.5}, fetches=["r"])
+    _retry_env(monkeypatch)
+    base = np.asarray(tfs.map_rows(prog, frame).column("r").data)
+    monkeypatch.setenv("TFS_MIN_SPLIT_ROWS", "4")
+    _retry_env(monkeypatch, inject="oom:block=2:minrows=15")
+    got = np.asarray(tfs.map_rows(prog, frame).column("r").data)
+    np.testing.assert_array_equal(base, got)
+
+
+def test_oom_split_floor_surfaces_row_range(monkeypatch):
+    frame = _frame(n=80, nb=4)
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0}, fetches=["y"])
+    monkeypatch.setenv("TFS_MIN_SPLIT_ROWS", "4")
+    _retry_env(monkeypatch, inject="oom:block=0")  # OOM at every size
+    with pytest.raises(
+        fault_tolerance.BlockExecutionError,
+        match=r"block 0 rows \[\d+, \d+\).*split floor",
+    ):
+        tfs.map_blocks(prog, frame)
+
+
+def test_oom_floor_blocks_split_entirely(monkeypatch):
+    frame = _frame(n=80, nb=4)
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0}, fetches=["y"])
+    # floor >= block size: no split is ever allowed
+    monkeypatch.setenv("TFS_MIN_SPLIT_ROWS", "64")
+    _retry_env(monkeypatch, inject="oom:block=1:attempt=0")
+    with pytest.raises(
+        fault_tolerance.BlockExecutionError, match="split floor|at the split"
+    ):
+        tfs.map_blocks(prog, frame)
+
+
+def test_oom_cross_row_program_surfaces_immediately(monkeypatch):
+    frame = _frame(n=80, nb=4)
+    cross = tfs.Program.wrap(
+        lambda x: {"y": x - x.mean(0)}, fetches=["y"]
+    )
+    monkeypatch.setenv("TFS_MIN_SPLIT_ROWS", "4")
+    _retry_env(monkeypatch, inject="oom:block=1:attempt=0")
+    with pytest.raises(
+        fault_tolerance.BlockExecutionError,
+        match=r"block 1 rows \[0, 20\).*row-independent",
+    ):
+        tfs.map_blocks(cross, frame)
+
+
+def test_oom_trimmed_map_surfaces_immediately(monkeypatch):
+    frame = _frame(n=80, nb=4)
+    trimmed = tfs.Program.wrap(
+        lambda x: {"s": x.sum(0, keepdims=True)}, fetches=["s"]
+    )
+    monkeypatch.setenv("TFS_MIN_SPLIT_ROWS", "4")
+    _retry_env(monkeypatch, inject="oom:block=0:attempt=0")
+    with pytest.raises(
+        fault_tolerance.BlockExecutionError, match="trimmed"
+    ):
+        tfs.map_blocks(trimmed, frame, trim=True)
+
+
+# ---------------------------------------------------------------------------
+# donation safety on retried blocks
+# ---------------------------------------------------------------------------
+
+
+def test_donated_then_failed_buffer_never_reused(monkeypatch):
+    """A retried block must RE-STAGE: the attempt-0 buffers may have been
+    donated to the failed executable and are dead either way."""
+    frame = _frame(n=96, nb=6)
+    before = np.asarray(frame.column("x").data).copy()
+    prog = tfs.Program.wrap(lambda x: {"y": x * 4.0}, fetches=["y"])
+    monkeypatch.setenv("TFS_DONATE", "1")  # force the donating entries
+    monkeypatch.setenv("TFS_PREFETCH_BLOCKS", "2")
+    _retry_env(monkeypatch)
+    base = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+
+    stage_calls = []
+    orig = engine.Executor._device_inputs
+
+    def counting(self, program, block, infos, host_stage=None, pad_to=None,
+                 device=None):
+        stage_calls.append(1)
+        return orig(self, program, block, infos, host_stage,
+                    pad_to=pad_to, device=device)
+
+    monkeypatch.setattr(engine.Executor, "_device_inputs", counting)
+    _retry_env(monkeypatch, inject="transient:block=3:attempt=0")
+    got = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    np.testing.assert_array_equal(base, got)
+    # one staging per block plus exactly one RE-staging for the retry
+    assert len(stage_calls) == frame.num_blocks + 1
+    # the host frame is untouched by donation (staged copies donate, the
+    # source column never does)
+    np.testing.assert_array_equal(
+        np.asarray(frame.column("x").data), before
+    )
+
+
+# ---------------------------------------------------------------------------
+# PoolRun satellite: narrowed copy_to_host_async fallback
+# ---------------------------------------------------------------------------
+
+
+class _BadAsyncCopy:
+    """Array-like whose async D2H copy always fails with a runtime error."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def copy_to_host_async(self):
+        raise RuntimeError("async D2H unsupported on this client")
+
+    def __array__(self, dtype=None):
+        return np.asarray(self._arr, dtype=dtype)
+
+
+def test_pool_copy_fallback_counted_and_logged_once(caplog):
+    from tensorframes_tpu.ops.device_pool import PoolRun
+
+    pool = PoolRun(["d0", "d1"], [0, 1], depth=1)
+    out_blocks = [None, None]
+    c0 = obs.counters()
+    with caplog.at_level("WARNING", logger="tensorframes_tpu.device_pool"):
+        pool.submit(
+            0, 0, 3, {"y": _BadAsyncCopy(np.arange(3.0))}, out_blocks
+        )
+        pool.submit(
+            1, 1, 3, {"y": _BadAsyncCopy(np.arange(3.0) + 1)}, out_blocks
+        )
+        pool.finish(out_blocks)
+    d = obs.counters_delta(c0)
+    assert d["pool_copy_fallbacks"] == 2  # every failure counted...
+    warnings = [
+        r for r in caplog.records if "copy_to_host_async" in r.getMessage()
+    ]
+    assert len(warnings) == 1  # ...but logged once per run
+    np.testing.assert_array_equal(out_blocks[0]["y"], np.arange(3.0))
+    np.testing.assert_array_equal(out_blocks[1]["y"], np.arange(3.0) + 1)
+
+
+def test_pool_copy_unexpected_exception_propagates():
+    from tensorframes_tpu.ops.device_pool import PoolRun
+
+    class _Buggy:
+        def copy_to_host_async(self):
+            raise TypeError("a bug, not a backend quirk")
+
+        def __array__(self, dtype=None):  # pragma: no cover
+            return np.zeros(1)
+
+    pool = PoolRun(["d0", "d1"], [0], depth=1)
+    with pytest.raises(TypeError, match="bug"):
+        pool.submit(0, 0, 1, {"y": _Buggy()}, [None])
+
+
+# ---------------------------------------------------------------------------
+# pooled dispatch (process-isolated: test_pooled_*)
+# ---------------------------------------------------------------------------
+
+
+def _chaos_spec():
+    rate = os.environ.get("TFS_CHAOS_RATE", "0.25")
+    seed = os.environ.get("TFS_CHAOS_SEED", "7")
+    return f"transient:rate={rate}:seed={seed}"
+
+
+def test_pooled_quarantine_drains_failing_device(monkeypatch):
+    """A persistently failing device is quarantined after
+    TFS_QUARANTINE_AFTER transient failures and its blocks re-dispatch
+    to healthy devices — bit-identically."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_QUARANTINE_AFTER", "2")
+    _retry_env(monkeypatch, retries="3")
+    frame = _frame(n=160, nb=16)
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0 + 1.0}, fetches=["y"])
+    monkeypatch.setenv("TFS_DEVICE_POOL", "0")
+    base = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:device=2")
+    obs.enable()
+    try:
+        c0 = obs.counters()
+        got = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+        d = obs.counters_delta(c0)
+        span = obs.last_spans(1)[0]
+    finally:
+        obs.disable()
+    np.testing.assert_array_equal(base, got)
+    assert d["devices_quarantined"] == 1
+    assert d["block_retries"] == 2  # the two failures before the drain
+    assert span["fault_tolerance"]["quarantined_devices"] == [2]
+    assert span["device_pool"]["quarantined_devices"] == [2]
+    assert span["device_pool"]["failures_per_device"][2] == 2
+    # every block still dispatched and assembled
+    assert d["pool_blocks"] == frame.num_blocks
+
+
+def test_pooled_degrades_to_serial_when_one_device_left(monkeypatch):
+    """With every device but one drained, the pool IS the serial path on
+    the survivor — the frame still completes bit-identically."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "3")
+    monkeypatch.setenv("TFS_QUARANTINE_AFTER", "1")
+    _retry_env(monkeypatch, retries="4")
+    frame = _frame(n=120, nb=12)
+    prog = tfs.Program.wrap(lambda x: {"y": x * 3.0 - 1.0}, fetches=["y"])
+    monkeypatch.setenv("TFS_DEVICE_POOL", "0")
+    base = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    monkeypatch.setenv("TFS_DEVICE_POOL", "3")
+    monkeypatch.setenv(
+        "TFS_FAULT_INJECT", "transient:device=1;transient:device=2"
+    )
+    obs.enable()
+    try:
+        got = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+        span = obs.last_spans(1)[0]
+    finally:
+        obs.disable()
+    np.testing.assert_array_equal(base, got)
+    assert span["fault_tolerance"]["quarantined_devices"] == [1, 2]
+    # all post-drain work landed on the one healthy device
+    rec = span["device_pool"]
+    assert rec["blocks_per_device"][0] > rec["blocks_per_device"][1]
+
+
+def test_pooled_all_devices_quarantined_fails_loudly(monkeypatch):
+    monkeypatch.setenv("TFS_DEVICE_POOL", "2")
+    monkeypatch.setenv("TFS_QUARANTINE_AFTER", "1")
+    _retry_env(monkeypatch, retries="6")
+    frame = _frame(n=80, nb=8)
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0}, fetches=["y"])
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient")  # every dispatch
+    with pytest.raises(
+        (fault_tolerance.BlockExecutionError, RestartBudgetExceeded)
+    ):
+        tfs.map_blocks(prog, frame)
+
+
+def test_pooled_chaos_six_verbs_bit_identical(monkeypatch):
+    """The acceptance gate: with transient faults injected at >= 25% of
+    block dispatches, all six verbs complete and return exactly the
+    fault-free bytes."""
+    monkeypatch.setenv("TFS_QUARANTINE_AFTER", "50")
+    _retry_env(monkeypatch, retries="4")
+    frame = _frame(n=120, nb=6)
+    mapb = tfs.Program.wrap(
+        lambda x: {"y": jnp.tanh(x) * 2.0 + x}, fetches=["y"]
+    )
+    mapr = tfs.Program.wrap(lambda x: {"r": x.sum() + x[0]}, fetches=["r"])
+    trimmed = tfs.Program.wrap(
+        lambda x: {"s": x.sum(0, keepdims=True)}, fetches=["s"]
+    )
+    pair = tfs.Program.wrap(
+        lambda x_1, x_2: {"x": x_1 + 3.0 * x_2}, fetches=["x"]
+    )
+    blockred = tfs.Program.wrap(
+        lambda x_input: {"x": (x_input * 1.3).sum(0)}, fetches=["x"]
+    )
+    agg = tfs.Program.wrap(
+        lambda x_input: {"x": x_input.sum(0)}, fetches=["x"]
+    )
+
+    def run_all():
+        out = {}
+        out["map_blocks"] = np.asarray(
+            tfs.map_blocks(mapb, frame).column("y").data
+        )
+        out["map_rows"] = np.asarray(
+            tfs.map_rows(mapr, frame).column("r").data
+        )
+        out["trimmed"] = np.asarray(
+            tfs.map_blocks(trimmed, frame, trim=True).column("s").data
+        )
+        out["reduce_rows_tree"] = tfs.reduce_rows(pair, frame, mode="tree")[
+            "x"
+        ]
+        out["reduce_rows_seq"] = tfs.reduce_rows(
+            pair, frame, mode="sequential"
+        )["x"]
+        out["reduce_blocks"] = tfs.reduce_blocks(blockred, frame)["x"]
+        a = tfs.aggregate(agg, frame.group_by("k"))
+        out["aggregate_k"] = np.asarray(a.column("k").data)
+        out["aggregate_x"] = np.asarray(a.column("x").data)
+        return out
+
+    monkeypatch.setenv("TFS_DEVICE_POOL", "0")
+    monkeypatch.setenv("TFS_FAULT_INJECT", "")
+    base = run_all()
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_FAULT_INJECT", _chaos_spec())
+    c0 = obs.counters()
+    chaotic = run_all()
+    d = obs.counters_delta(c0)
+    for name in base:
+        np.testing.assert_array_equal(
+            base[name], chaotic[name], err_msg=name
+        )
+    assert d["faults_injected"] >= 1  # adversity actually happened
+    assert d["block_retries"] == d["faults_injected"]
+
+
+def test_pooled_chaos_pipeline_bit_identical(monkeypatch):
+    monkeypatch.setenv("TFS_QUARANTINE_AFTER", "50")
+    _retry_env(monkeypatch, retries="4")
+    frame = _frame(n=122, nb=4)  # uneven: exercises bucket-padded chain
+
+    def chain():
+        return (
+            pipeline(frame)
+            .map_rows(lambda x: {"z": x * 2.0})
+            .map_blocks(lambda z: {"w": z + 1.0})
+        )
+
+    monkeypatch.setenv("TFS_DEVICE_POOL", "0")
+    monkeypatch.setenv("TFS_FAULT_INJECT", "")
+    fused = chain().run()
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_FAULT_INJECT", _chaos_spec())
+    chaotic = chain().run()
+    for col in ("w", "z", "x", "k"):
+        np.testing.assert_array_equal(
+            np.asarray(fused.column(col).data),
+            np.asarray(chaotic.column(col).data),
+            err_msg=col,
+        )
+    assert chaotic.offsets == fused.offsets
+
+
+def test_pooled_streamed_block_follows_quarantine_redirect(monkeypatch):
+    """A chunk-STREAMED block whose device drains mid-block re-stages
+    its remaining chunk retries onto healthy devices (regression: the
+    redirect used to apply only to unstreamed blocks, so a streamed
+    block exhausted its budget against the drained device)."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "3")
+    monkeypatch.setenv("TFS_QUARANTINE_AFTER", "2")
+    _retry_env(monkeypatch, retries="4")
+    monkeypatch.setenv("TFS_PREFETCH_BLOCKS", "2")
+    rng = np.random.RandomState(2)
+    arrs = {"x": rng.rand(1024, 8).astype(np.float32)}
+    prog = tfs.Program.wrap(lambda x: {"y": x * 3.0 - 1.0}, fetches=["y"])
+
+    def run():
+        frame = tfs.analyze(
+            tfs.TensorFrame.from_arrays(arrs, num_blocks=2)
+        )
+        return np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+
+    monkeypatch.setenv("TFS_DEVICE_POOL", "0")
+    base = run()
+    monkeypatch.setattr(engine.Executor, "stream_chunk_bytes", 4096)
+    monkeypatch.setenv("TFS_DEVICE_POOL", "3")
+    # device 1 fails persistently: its streamed block must complete on
+    # the healthy devices after the drain
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:device=1")
+    obs.enable()
+    try:
+        c0 = obs.counters()
+        got = run()
+        d = obs.counters_delta(c0)
+        span = obs.last_spans(1)[0]
+    finally:
+        obs.disable()
+    np.testing.assert_array_equal(base, got)
+    assert d["devices_quarantined"] == 1
+    assert span["fault_tolerance"]["quarantined_devices"] == [1]
+
+
+def test_pooled_oom_split_bit_identical(monkeypatch):
+    """OOM degradation under the pool: the split halves re-dispatch on
+    the block's (effective) device and reassemble by index."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_MIN_SPLIT_ROWS", "4")
+    _retry_env(monkeypatch, retries="2")
+    frame = _frame(n=160, nb=8)
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0 + 1.0}, fetches=["y"])
+    monkeypatch.setenv("TFS_DEVICE_POOL", "0")
+    monkeypatch.setenv("TFS_FAULT_INJECT", "")
+    base = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_FAULT_INJECT", "oom:block=5:minrows=15")
+    c0 = obs.counters()
+    got = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    d = obs.counters_delta(c0)
+    np.testing.assert_array_equal(base, got)
+    assert d["block_oom_splits"] >= 1

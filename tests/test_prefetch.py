@@ -314,3 +314,49 @@ def test_pipeline_iterate_parity_under_donation(monkeypatch):
     np.testing.assert_array_equal(
         np.asarray(got_hist["g"]), np.asarray(ref_hist["g"])
     )
+
+
+# ---------------------------------------------------------------------------
+# round 9: staging exceptions carry block context (StagingError)
+# ---------------------------------------------------------------------------
+
+
+def test_staging_failure_names_block_and_lane():
+    """A mid-stream staging exception crosses the queue wrapped with the
+    failing item index and prefetcher name, `raise ... from` the
+    original — a frame-scale failure points at a block, not at a bare
+    queue.get."""
+
+    def stage(i):
+        if i == 2:
+            raise ConnectionResetError("link dropped mid-transfer")
+        return i * 10
+
+    pf = prefetch.Prefetcher(stage, 5, depth=2, name="tfs-lane-d3")
+    got = []
+    with pytest.raises(prefetch.StagingError) as ei:
+        for v in pf:
+            got.append(v)
+    assert got == [0, 10]  # items before the failure still arrive in order
+    msg = str(ei.value)
+    assert "tfs-lane-d3" in msg and "block 2" in msg
+    assert isinstance(ei.value.__cause__, ConnectionResetError)
+    # classification walks the cause: a wrapped network loss is transient
+    from tensorframes_tpu.resilience import FailureDetector
+
+    assert FailureDetector().is_transient(ei.value)
+
+
+def test_staging_validation_error_passes_through_unwrapped():
+    """Program-contract errors keep their documented type: a host_stage
+    ValidationError raised on the worker surfaces as ValidationError."""
+    from tensorframes_tpu.ops.validation import ValidationError
+
+    def stage(i):
+        if i == 1:
+            raise ValidationError("host_stage for input 'raw' misbehaved")
+        return i
+
+    pf = prefetch.Prefetcher(stage, 3, depth=2)
+    with pytest.raises(ValidationError, match="host_stage"):
+        list(pf)

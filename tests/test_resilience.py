@@ -2,12 +2,16 @@
 reference delegates to Spark task retry; the TPU equivalent is
 checkpoint-based step restart)."""
 
+import random
+
 import numpy as np
 import pytest
 
 import tensorframes_tpu as tfs
 from tensorframes_tpu.checkpoint import Checkpointer
 from tensorframes_tpu.resilience import (
+    _TRANSIENT_MARKERS,
+    _TRANSIENT_XLA_STATUS,
     FailureDetector,
     RestartBudgetExceeded,
     run_restartable,
@@ -147,3 +151,103 @@ def test_backoff_grows():
         d.on_failure(FakePreemption()),
     ]
     assert delays == [1.0, 2.0, 4.0]
+
+
+# ---------------------------------------------------------------------------
+# round 9: the full classification table, decorrelated jitter, cause-walk
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("status", _TRANSIENT_XLA_STATUS)
+def test_every_transient_xla_status_retries(status):
+    """Each entry of ``_TRANSIENT_XLA_STATUS`` rescues a jax runtime
+    error whose message is otherwise marker-free."""
+    from jax.errors import JaxRuntimeError
+
+    d = FailureDetector()
+    assert d.is_transient(
+        JaxRuntimeError(f"{status.upper()}: something runtime-shaped")
+    )
+
+
+@pytest.mark.parametrize("marker", _TRANSIENT_MARKERS)
+def test_every_transient_marker_retries(marker):
+    """Each entry of ``_TRANSIENT_MARKERS`` classifies transient, even on
+    a plain RuntimeError (text-only rescue path)."""
+    d = FailureDetector()
+    assert d.is_transient(RuntimeError(f"runtime lost: {marker} observed"))
+
+
+@pytest.mark.parametrize(
+    "exc",
+    [
+        # INTERNAL is fatal without preemption context: XLA tags
+        # deterministic compiler bugs INTERNAL (ADVICE r2)
+        RuntimeError("INTERNAL: Mosaic failed to compile kernel"),
+        ValueError("bad shape"),
+        TypeError("not a pytree"),
+        KeyError("missing column"),
+        AttributeError("no such method"),
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory"),  # OOM != retry
+    ],
+    ids=lambda e: type(e).__name__ + ":" + str(e)[:24],
+)
+def test_fatal_classes_never_retry(exc):
+    assert not FailureDetector().is_transient(exc)
+
+
+def test_internal_is_fatal_as_jax_runtime_error():
+    from jax.errors import JaxRuntimeError
+
+    assert not FailureDetector().is_transient(
+        JaxRuntimeError("INTERNAL: compiler assertion failed")
+    )
+
+
+def test_cause_chain_classification():
+    """An inconclusive wrapper defers to its explicit ``raise ... from``
+    cause — a wrapped transfer loss stays retryable, a wrapped program
+    bug stays fatal (the StagingError contract in ops/prefetch.py)."""
+    d = FailureDetector()
+
+    def chained(inner):
+        try:
+            raise inner
+        except type(inner) as e:
+            try:
+                raise RuntimeError("lane-3: staging block 7 failed") from e
+            except RuntimeError as wrapper:
+                return wrapper
+
+    assert d.is_transient(chained(ConnectionResetError("peer vanished")))
+    assert not d.is_transient(chained(ValueError("bad cell shape")))
+
+
+def test_jitter_zero_keeps_exact_sequence():
+    d = FailureDetector(
+        max_restarts=3, backoff_s=1.0, backoff_factor=2.0, jitter=0.0
+    )
+    assert [
+        d.on_failure(FakePreemption()),
+        d.on_failure(FakePreemption()),
+        d.on_failure(FakePreemption()),
+    ] == [1.0, 2.0, 4.0]
+
+
+def test_jitter_deterministic_with_injected_rng():
+    mk = lambda: FailureDetector(  # noqa: E731
+        max_restarts=5,
+        backoff_s=1.0,
+        backoff_factor=2.0,
+        jitter=1.0,
+        rng=random.Random(42),
+    )
+    d1, d2 = mk(), mk()
+    s1 = [d1.on_failure(FakePreemption()) for _ in range(5)]
+    s2 = [d2.on_failure(FakePreemption()) for _ in range(5)]
+    assert s1 == s2  # injectable rng -> jittered tests stay exact
+    cap = 1.0 * 2.0 ** 4
+    for delay in s1:
+        assert 1.0 <= delay <= cap  # within [base, exponential ceiling]
+    # decorrelated: the sequence is not the bare exponential
+    assert s1 != [1.0, 2.0, 4.0, 8.0, 16.0]

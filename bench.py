@@ -20,6 +20,7 @@ reference has no training loop or serving path):
 | 9 | uncached-frame ingestion, chunked h2d + prefetch on vs off | net-new (r6) |
 | 11 | device-pool map_blocks scaling, 1 vs N devices + overlap on/off | SURVEY P1 (r8) |
 | 12 | chaos bench: injected transient-fault rate x throughput + bit-identity | SURVEY §5 (r9) |
+| 13 | sharded HBM frame cache: epochs-over-cached-frame, serial vs sharded + adoption | kmeans_demo cache() (r10) |
 
 Round 6: the headline record carries ``ceiling_mfu`` (the roofline shape-mix
 ceiling from ``tensorframes_tpu.roofline``) next to the measured ``mfu``;
@@ -215,9 +216,12 @@ def bench_reduce_blocks(jax, tfs) -> None:
     vals = rng.rand(n, d).astype(np.float32)
     fn = lambda v_input: {"v": v_input.sum(0)}  # noqa: E731
 
+    # sharded=False: configs 2/3/5 measure the FUSED single-dispatch path
+    # (and their cpu legs must not shard onto accelerator devices);
+    # config 13 measures the sharded-cache affinity path
     frame = tfs.analyze(
         tfs.TensorFrame.from_arrays({"v": vals}, num_blocks=4)
-    ).cache()
+    ).cache(sharded=False)
     pipe = pipeline(frame).reduce_blocks(fn)
     pipe.collect()  # warm (compile)
 
@@ -232,7 +236,7 @@ def bench_reduce_blocks(jax, tfs) -> None:
         with jax.default_device(jax.devices("cpu")[0]):
             cpu_frame = tfs.analyze(
                 tfs.TensorFrame.from_arrays({"v": vals}, num_blocks=4)
-            ).cache()
+            ).cache(sharded=False)
             cpu_prog = tfs.Program.wrap(fn, fetches=["v"])
 
             def run_cpu_eager():
@@ -317,7 +321,7 @@ def bench_map_rows_mlp(jax, tfs) -> None:
     feats = rng.rand(n, 784).astype(np.float32)
     frame = tfs.analyze(
         tfs.TensorFrame.from_arrays({"pixels": feats}, num_blocks=4)
-    ).cache()
+    ).cache(sharded=False)
     program = import_graphdef(
         graph, fetches=["prediction"], inputs={"image": "pixels"}
     )
@@ -344,7 +348,7 @@ def bench_map_rows_mlp(jax, tfs) -> None:
         with jax.default_device(jax.devices("cpu")[0]):
             cpu_frame = tfs.analyze(
                 tfs.TensorFrame.from_arrays({"pixels": feats}, num_blocks=4)
-            ).cache()
+            ).cache(sharded=False)
             cpu_prog = import_graphdef(
                 graph, fetches=["prediction"], inputs={"image": "pixels"}
             )
@@ -419,7 +423,7 @@ def bench_logreg_step(jax, tfs) -> None:
         tfs.TensorFrame.from_arrays(
             {"features": feats, "label": labels}, num_blocks=4
         )
-    ).cache()
+    ).cache(sharded=False)
 
     # round-4 rework: the whole step (map_blocks_trimmed grad partials ->
     # reduce_blocks sum -> SGD update) is ONE fused dispatch, and iterate(K)
@@ -442,7 +446,7 @@ def bench_logreg_step(jax, tfs) -> None:
                 tfs.TensorFrame.from_arrays(
                     {"features": feats, "label": labels}, num_blocks=4
                 )
-            ).cache()
+            ).cache(sharded=False)
             # eager per-verb path (the r3 baseline)
             cpu_progs: dict = {}
             cpu_params = lr.init(d)
@@ -1267,6 +1271,232 @@ def bench_chaos(jax, tfs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# config #13: sharded HBM frame cache — epochs over a cached frame
+# ---------------------------------------------------------------------------
+
+
+def _frame_cache_measure() -> dict:
+    """The config-13 measurement body: the reference's canonical cached
+    workload (``kmeans_demo.py`` caches the DataFrame, then iterates) as
+    an epochs-over-cached-frame curve.
+
+    Three legs over the SAME frame and program:
+
+    * **serial-cached** — ``cache()`` single-device (the round-2 layout;
+      before round 10, device-resident frames were locked out of the
+      pool, so this WAS the cached ceiling);
+    * **sharded-cached** — ``cache(sharded=True)`` + affinity dispatch
+      across every local device, with per-epoch ``h2d_bytes_staged``
+      (must be 0: the bytes moved once, at cache time) and the
+      per-device occupancy/blocks evidence from the scheduler span;
+    * **adoption** — a pooled pipeline chain run epoch-over-epoch, each
+      epoch's output frame adopting its per-device output buffers as
+      shards: ``h2d_per_epoch`` must fall to 0 after epoch 1.
+
+    Per-block compute is a dependent scan (serial within a block), so
+    the serial-vs-sharded ratio isolates the scheduler exactly like
+    config 11.  Runs in the bench parent when it has >= 2 local devices,
+    else in the forced-8-host-device CPU child
+    (``TFS_BENCH_CACHE_CHILD``)."""
+    import jax
+    import jax.numpy as jnp
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import observability as obs
+    from tensorframes_tpu.ops import frame_cache
+    from tensorframes_tpu.ops.pipeline import pipeline as tfs_pipeline
+
+    rows_per_block, d, K, nb, epochs = 64, 16, 1500, 16, 4
+    n = rows_per_block * nb
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, d).astype(np.float32)
+    w = ((rng.rand(d, d) - 0.5) / d).astype(np.float32)
+
+    def fn(x):
+        def step(c, _):
+            return jnp.tanh(c @ w), None
+
+        out, _ = jax.lax.scan(step, x, None, length=K)
+        return {"y": out}
+
+    program = tfs.Program.wrap(fn, fetches=["y"])
+
+    knobs = ("TFS_DEVICE_POOL", "TFS_CACHE_SHARDED", "TFS_PREFETCH_BLOCKS")
+    old = {k: os.environ.get(k) for k in knobs}
+
+    def leg(pool: str, sharded: bool):
+        os.environ["TFS_DEVICE_POOL"] = pool
+        os.environ["TFS_PREFETCH_BLOCKS"] = "2"
+        frame = tfs.TensorFrame.from_arrays({"x": x}, num_blocks=nb)
+        obs.enable()
+        try:
+            c0 = obs.counters()
+            cached = frame.cache(sharded=sharded)
+            stage_bytes = obs.counters_delta(c0)["h2d_bytes_staged"]
+            best, span, arr_best = float("inf"), {}, None
+            h2d_per_epoch, rows_s_per_epoch = [], []
+            for e in range(epochs):  # epoch 0 pays the compile
+                c0 = obs.counters()
+                t0 = time.perf_counter()
+                out = tfs.map_blocks(program, cached)
+                arr = np.asarray(out.column("y").data)
+                dt = time.perf_counter() - t0
+                delta = obs.counters_delta(c0)
+                h2d_per_epoch.append(delta["h2d_bytes_staged"])
+                rows_s_per_epoch.append(round(n / dt, 1))
+                if e and dt < best:
+                    best, arr_best = dt, arr
+                    span = obs.last_spans(1)[0]
+            cached.uncache()
+        finally:
+            obs.disable()
+        rec = span.get("device_pool", {})
+        return {
+            "rows_s": round(n / best, 1),
+            "rows_s_per_epoch": rows_s_per_epoch,
+            "h2d_per_epoch": h2d_per_epoch,
+            "cache_stage_bytes": stage_bytes,
+            "blocks_per_device": rec.get("blocks_per_device"),
+            "occupancy": rec.get("occupancy"),
+            "arr": arr_best,
+        }
+
+    def adoption_leg():
+        os.environ["TFS_DEVICE_POOL"] = "auto"
+        os.environ["TFS_CACHE_SHARDED"] = "auto"
+        os.environ["TFS_PREFETCH_BLOCKS"] = "2"
+        cur = tfs.TensorFrame.from_arrays({"x": x}, num_blocks=nb)
+        h2d, adopted = [], []
+        for e in range(epochs):
+            c0 = obs.counters()
+            cur = (
+                tfs_pipeline(cur)
+                .map_blocks(lambda x: {"x": jnp.tanh(x @ w)})
+                .run()
+            )
+            h2d.append(obs.counters_delta(c0)["h2d_bytes_staged"])
+            adopted.append(
+                frame_cache.active_cache(cur) is not None
+            )
+        return {"h2d_per_epoch": h2d, "adopted_per_epoch": adopted}
+
+    try:
+        serial = leg("0", sharded=False)
+        sharded = leg("auto", sharded=True)
+        adoption = adoption_leg()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    bit_identical = bool(
+        np.array_equal(serial.pop("arr"), sharded.pop("arr"))
+    )
+    return {
+        "value": sharded["rows_s"],
+        "devices": len(jax.local_devices()),
+        "serial_cached_rows_s": serial["rows_s"],
+        "speedup_vs_serial_cached": round(
+            sharded["rows_s"] / serial["rows_s"], 2
+        ),
+        "sharded": {k: v for k, v in sharded.items() if k != "rows_s"},
+        "serial": {
+            k: v
+            for k, v in serial.items()
+            if k in ("rows_s_per_epoch", "h2d_per_epoch", "cache_stage_bytes")
+        },
+        "adoption": adoption,
+        "bit_identical": bit_identical,
+        "h2d_zero_after_cache": all(
+            b == 0 for b in sharded["h2d_per_epoch"]
+        ),
+        "workload": (
+            f"map_blocks scan({K} x {d}x{d} matmul) over {n}x{d} f32, "
+            f"{nb} blocks, {epochs} epochs over one cached frame"
+        ),
+    }
+
+
+def bench_frame_cache(jax, tfs) -> None:
+    """Config 13 (round 10): the sharded HBM frame cache — the cached
+    iterative workload the reference's demos model (``cache()`` then
+    iterate), measured as an epochs curve: single-device cached (the old
+    ceiling: device-resident frames were pinned off the pool) vs
+    sharded-cached affinity dispatch, with per-epoch H2D evidence and a
+    pooled-pipeline adoption leg whose staging falls to zero after epoch
+    1.  Single-chip parents measure in the forced-8-host-device CPU
+    child, like config 11; the same XLA:CPU shared-runner floor applies
+    to the throughput ratio there."""
+    import subprocess
+    import sys
+
+    if len(jax.local_devices()) >= 2:
+        m = _frame_cache_measure()
+        m["forced_host_devices"] = False
+    else:
+        env = dict(os.environ)
+        env["TFS_BENCH_CACHE_CHILD"] = "1"
+        env["TFS_BENCH_KEEP_STDERR"] = "1"  # parent owns bench_stderr.log
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        for k in ("TFS_DEVICE_POOL", "TFS_CACHE_SHARDED",
+                  "TFS_PREFETCH_BLOCKS", "TFS_HBM_BUDGET"):
+            env.pop(k, None)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        if proc.returncode != 0 or not proc.stdout.strip():
+            raise RuntimeError(
+                f"frame-cache child failed (rc={proc.returncode}): "
+                f"{(proc.stderr or proc.stdout)[-400:]}"
+            )
+        m = json.loads(proc.stdout.strip().splitlines()[-1])
+        m["forced_host_devices"] = True
+
+    serial_rows_s = m.pop("serial_cached_rows_s")
+    _emit(
+        {
+            "metric": (
+                "sharded-cached map_blocks epochs throughput "
+                f"({m.get('devices')} devices, zero H2D)"
+            ),
+            "value": m.pop("value"),
+            "unit": "rows/sec",
+            "vs_baseline": m.get("speedup_vs_serial_cached"),
+            "baseline": (
+                f"same verb over the single-device cached frame "
+                f"({serial_rows_s} rows/s — the pre-round-10 cached "
+                f"ceiling: device-resident frames were locked out of "
+                f"the pool)"
+            ),
+            "config": 13,
+            **m,
+            "note": (
+                "h2d_per_epoch proves the cached loop's transfer bill: "
+                "the sharded legs stage bytes ONCE at cache() time "
+                "(cache_stage_bytes) and every epoch after reads HBM "
+                "shards in place (h2d_zero_after_cache); the adoption "
+                "leg chains pooled pipeline epochs, each output frame "
+                "adopting its per-device buffers, so h2d falls to zero "
+                "after epoch 1 with no explicit cache() call. "
+                "bit_identical pins sharded bytes == serial-cached "
+                "bytes; the forced-CPU child's throughput ratio sits on "
+                "the same shared-execution-runner floor as config 11"
+            ),
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
 # config #4 (headline, printed last): Inception-v3 map_blocks scoring
 # ---------------------------------------------------------------------------
 
@@ -1525,6 +1755,11 @@ def main() -> None:
         print(json.dumps(_device_pool_measure()), flush=True)
         return
 
+    # config-13 child mode: same forced multi-device topology, cache legs
+    if os.environ.get("TFS_BENCH_CACHE_CHILD") == "1":
+        print(json.dumps(_frame_cache_measure()), flush=True)
+        return
+
     import jax
 
     # persistent XLA executable cache: first-ever compile of Inception over a
@@ -1557,6 +1792,7 @@ def main() -> None:
         bench_shape_canonical,
         bench_device_pool,
         bench_chaos,
+        bench_frame_cache,
         bench_lm_train,
         bench_lm_train_wide,
         bench_decode,

@@ -20,6 +20,16 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 JAX_PLATFORMS=cpu \
   python -m pytest tests/test_device_pool.py -q
 
+# Cached tier: the sharded HBM frame-cache tests run against the same
+# forced 8-device host (block-affinity placement, zero-H2D affinity
+# dispatch, LRU budget eviction, pipeline adoption).  Like the pool
+# tier, test_pooled_* items re-isolate into fresh interpreters via
+# conftest so per-device jit caches and budget state never leak.
+echo "== cached tier (sharded frame cache, forced 8 host devices) =="
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_frame_cache.py -q
+
 # Chaos tier: the fault-tolerance tests re-run under a TFS_FAULT_INJECT
 # matrix (rate:seed pairs consumed by the chaos-parameterised tests via
 # TFS_CHAOS_RATE/TFS_CHAOS_SEED).  The injection schedule is a
@@ -37,4 +47,5 @@ for rs in "0.25:7" "0.4:11"; do
 done
 
 echo "== pytest =="
-exec python -m pytest tests/ -q --ignore=tests/test_device_pool.py "$@"
+exec python -m pytest tests/ -q --ignore=tests/test_device_pool.py \
+  --ignore=tests/test_frame_cache.py "$@"

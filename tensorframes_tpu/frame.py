@@ -18,6 +18,7 @@ scalars/lists (Spark ``createDataFrame`` style), column arrays, and pandas.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -26,6 +27,25 @@ from . import dtypes
 from .dtypes import ScalarType
 from .schema import ColumnInfo, Schema, SchemaError
 from .shape import UNKNOWN, Shape
+
+
+_log = logging.getLogger("tensorframes_tpu.frame")
+
+# cache() skip log, one shot per distinct (columns, reasons) set: the
+# answer to "why does a cached frame still stage H2D bytes?" should land
+# in the log exactly once, not per verb call or per epoch
+_cache_skip_logged: set = set()
+
+
+def _warn_skipped_once(detail: str) -> None:
+    if detail not in _cache_skip_logged:
+        _cache_skip_logged.add(detail)
+        _log.warning(
+            "cache(): some columns stay on host and will keep paying "
+            "host->device staging — %s. Pass strict=True to make this an "
+            "error.",
+            detail,
+        )
 
 
 def is_device_array(x) -> bool:
@@ -409,7 +429,12 @@ class TensorFrame:
     def select(self, names: Sequence[str]) -> "TensorFrame":
         return TensorFrame([self.column(n) for n in names], self._offsets)
 
-    def cache(self, device=None) -> "TensorFrame":
+    def cache(
+        self,
+        device=None,
+        sharded: Optional[bool] = None,
+        strict: bool = False,
+    ) -> "TensorFrame":
         """Pin device-feedable columns in device memory (HBM).
 
         The Spark ``df.cache()`` analog (the reference's demos cache the
@@ -418,12 +443,27 @@ class TensorFrame:
         column from HBM with zero host->device traffic.  Columns are
         immutable, so the cached copy can never go stale.
 
-        Stays on host: binary and ragged columns (host inputs by
-        definition), and 64-bit columns when jax runs without x64 — caching
-        those would silently truncate the stored values (device_put
-        canonicalises to 32-bit) while the schema still claims 64; the host
-        copy remains authoritative and verbs keep casting per block.  Cast
-        the column to a 32-bit dtype first to cache it.
+        ``sharded`` (round 10, ``ops/frame_cache.py``): ``True`` places
+        each BLOCK's column slices on that block's pool device — the
+        deterministic least-loaded plan the device-pool scheduler uses —
+        so the engine's affinity dispatch runs the cached frame across
+        every device with zero H2D and no staging lanes.  ``None``
+        follows ``TFS_CACHE_SHARDED`` (``auto``: shard exactly when the
+        device pool is active); ``False`` forces the single-device
+        layout.  A sharded cache KEEPS the host columns as the
+        authoritative copy (eviction under ``TFS_HBM_BUDGET`` and
+        fault-tolerance re-staging both rebuild from it); the shards
+        ride along as ``frame._cache``.
+
+        Stays on host either way: binary and ragged columns (host inputs
+        by definition), and 64-bit columns when jax runs without x64 —
+        caching those would silently truncate the stored values
+        (device_put canonicalises to 32-bit) while the schema still
+        claims 64; the host copy remains authoritative and verbs keep
+        casting per block.  Cast the column to a 32-bit dtype first to
+        cache it.  Skipped columns are logged ONCE per distinct set with
+        their reasons (they are why H2D traffic persists on a "cached"
+        frame); ``strict=True`` raises instead.
 
         Transfers are issued through ``ops.prefetch.stage_columns`` — the
         engine's one transfer-issue policy point — so the per-column
@@ -431,18 +471,53 @@ class TensorFrame:
         the verbs' prefetch/donation machinery treats the columns as
         shared device state: never streamed, never donated
         (``ops/prefetch.py``'s safety contract)."""
-        from .ops import prefetch
+        from .ops import frame_cache, prefetch
 
         host: Dict[str, Any] = {}
+        skipped: Dict[str, str] = {}
         for c in self._columns:
             st = c.info.scalar_type
-            if not (
-                c.is_device
-                or c.is_ragged
-                or not st.device_ok
-                or dtypes.coerce(st) is not st
-            ):
+            if c.is_device:
+                continue  # already resident
+            if c.is_ragged:
+                skipped[c.info.name] = (
+                    "ragged (variable cell shapes; analyze/bucket first)"
+                )
+            elif not st.device_ok:
+                skipped[c.info.name] = (
+                    f"host-only scalar type {st.name} (binary/string)"
+                )
+            elif dtypes.coerce(st) is not st:
+                skipped[c.info.name] = (
+                    f"{st.name} would canonicalise to "
+                    f"{dtypes.coerce(st).name} on device (jax x64 is off; "
+                    f"cast the column first)"
+                )
+            else:
                 host[c.info.name] = c.data
+        if skipped:
+            detail = "; ".join(
+                f"{name}: {why}" for name, why in sorted(skipped.items())
+            )
+            if strict:
+                raise SchemaError(
+                    f"cache(strict=True): {len(skipped)} column(s) cannot "
+                    f"be cached on device — {detail}"
+                )
+            _warn_skipped_once(detail)
+        if device is not None and sharded:
+            raise SchemaError(
+                "cache(): device= pins every column on ONE device and "
+                "sharded=True requests block-affinity placement across "
+                "the pool — pass one or the other."
+            )
+        if device is None and sharded is not False:
+            devs = frame_cache.shard_devices(sharded)
+            if devs:
+                cache = frame_cache.build(self, sorted(host), devices=devs)
+                if cache is not None:
+                    out = TensorFrame(list(self._columns), self._offsets)
+                    return frame_cache.attach(out, cache)
         staged = prefetch.stage_columns(host, device)
         cols = [
             Column(c.info, staged[c.info.name])
@@ -453,7 +528,16 @@ class TensorFrame:
         return TensorFrame(cols, self._offsets)
 
     def uncache(self) -> "TensorFrame":
-        """Materialise device-resident columns back to host numpy."""
+        """Materialise device-resident columns back to host numpy; a
+        sharded cache (``cache(sharded=True)``) is released — its shards
+        drop out of the ``TFS_HBM_BUDGET`` accounting — and the
+        authoritative host columns carry over unchanged."""
+        from .ops import frame_cache
+
+        cache = getattr(self, "_cache", None)
+        if cache is not None:
+            cache.release()
+            frame_cache.attach(self, None)
         cols = [
             Column(c.info, np.asarray(c.data)) if c.is_device else c
             for c in self._columns

@@ -68,6 +68,12 @@ _counters: Dict[str, int] = {
     "devices_quarantined": 0,
     "faults_injected": 0,
     "pool_copy_fallbacks": 0,
+    # sharded frame cache (round 10): H2D traffic actually staged, shard
+    # servings, and LRU budget evictions — the counters that let a bench
+    # record PROVE a cached epoch paid zero host->device bytes
+    "h2d_bytes_staged": 0,
+    "cache_shard_hits": 0,
+    "cache_evictions": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
 
@@ -136,6 +142,27 @@ def note_pool_copy_fallback() -> None:
     """One ``copy_to_host_async`` failure in the pool readback window
     that fell back to synchronous readback (``PoolRun.submit``)."""
     _counters["pool_copy_fallbacks"] += 1
+
+
+def note_h2d_bytes(n: int) -> None:
+    """``n`` host bytes handed to ``jax.device_put`` by the engine's
+    staging paths (prefetch lanes, ``stage_columns``, cache builds,
+    pipeline entry staging).  The evidence counter behind the sharded
+    frame cache: an epoch served entirely from HBM shards leaves this
+    at zero."""
+    _counters["h2d_bytes_staged"] += int(n)
+
+
+def note_cache_shard_hit() -> None:
+    """One block dispatch served from a resident frame-cache shard
+    (``ops/frame_cache.py``) instead of host staging."""
+    _counters["cache_shard_hits"] += 1
+
+
+def note_cache_eviction() -> None:
+    """One cached shard evicted back to its authoritative host copy by
+    the ``TFS_HBM_BUDGET`` LRU."""
+    _counters["cache_evictions"] += 1
 
 
 @contextlib.contextmanager
@@ -213,6 +240,9 @@ def counters_delta(
             "devices_quarantined",
             "faults_injected",
             "pool_copy_fallbacks",
+            "h2d_bytes_staged",
+            "cache_shard_hits",
+            "cache_evictions",
         )
     }
 

@@ -197,11 +197,20 @@ class PoolRun:
     the remaining windows.  Assembly is always by block index."""
 
     def __init__(
-        self, devices: Sequence[Any], assignment: Sequence[int], depth: int
+        self,
+        devices: Sequence[Any],
+        assignment: Sequence[int],
+        depth: int,
+        affinity: bool = False,
     ):
         self.devices = list(devices)
         self.assignment = list(assignment)
         self.depth = max(1, int(depth))
+        # affinity runs (sharded frame cache, round 10) dispatch blocks
+        # on the device already holding their data: no staging lanes, so
+        # stage_s/overlap stats read 0 by design — the flag keeps span
+        # consumers from mistaking that for a dead prefetcher
+        self.affinity = bool(affinity)
         n = len(self.devices)
         self._window: List[List] = [[] for _ in range(n)]
         self.blocks = [0] * n
@@ -362,6 +371,8 @@ class PoolRun:
             ),
             "wall_s": round(wall, 6),
         }
+        if self.affinity:
+            rec["affinity"] = True
         if any(self.failures):
             rec["failures_per_device"] = list(self.failures)
             rec["quarantined_devices"] = sorted(self.quarantined)

@@ -53,6 +53,7 @@ from . import (
     bucketing,
     device_pool,
     fault_tolerance,
+    frame_cache,
     prefetch,
     segment_compile,
     validation,
@@ -192,6 +193,7 @@ class Executor:
         arr = np.asarray(value)
         if arr.dtype != st.np_dtype:
             arr = arr.astype(st.np_dtype)
+        observability.note_h2d_bytes(arr.nbytes)
         return jax.device_put(arr, device)
 
     def _staged_value(self, stage_fn, value, input_name: str) -> np.ndarray:
@@ -636,6 +638,18 @@ class Executor:
                     program, frame, infos, host_stage, rows_level
                 )
             ]
+        # sharded frame cache (round 10, ops/frame_cache.py): when the
+        # frame's blocks are resident on their affinity devices, each
+        # block dispatches on the device that already holds it — no
+        # staging lanes, no H2D, no donation (shards are shared state).
+        # This path removes the old "device-resident frames stay serial"
+        # restriction for every map verb.
+        cache = frame_cache.active_cache(frame)
+        if cache is not None:
+            return self._map_dispatch_sharded(
+                program, frame, infos, host_stage, span, rows_level, trim,
+                cache,
+            )
         # plan on the caller thread: _stream_plan and _bucket_plan may
         # trace (row-independence proofs); all jit entry points stay off
         # the worker
@@ -1193,6 +1207,128 @@ class Executor:
             span.annotate("fault_tolerance", session.record())
         return out_blocks
 
+    def _map_dispatch_sharded(
+        self,
+        program: Program,
+        frame: TensorFrame,
+        infos,
+        host_stage,
+        span,
+        rows_level: bool,
+        trim: bool,
+        cache,
+    ) -> List[Dict[str, Any]]:
+        """Affinity-aware dispatch for sharded-cached frames
+        (``ops/frame_cache.py``): block ``bi``'s program runs on the
+        device that already holds its cached column slices — the
+        residency plan IS the schedule (both come from
+        ``device_pool.assign`` on the same block sizes), so there are no
+        staging lanes and no H2D for resident blocks.  This removes the
+        old "device-resident frames stay serial" restriction.
+
+        Contract deltas from the host-fresh pool path, all deliberate:
+
+        * **no donation, ever** — shards are shared frame state, and a
+          donated shard would corrupt every later verb (the prefetch
+          safety contract).  The executables here are the same plain
+          entries the serial device-resident path runs, so results are
+          bit-identical to it (and to the host path).
+        * **no chunk streaming** — the bytes are already in HBM.
+        * **evicted blocks re-stage inline** from the authoritative host
+          columns to their affinity device (counted in
+          ``h2d_bytes_staged``); residency is an accelerator, never a
+          correctness dependency.
+        * **fault tolerance re-stages from host**: a retry or a
+          quarantine redirect never touches the (possibly dead) shard —
+          every attempt past the first builds fresh buffers from the
+          host copy on the CURRENT effective device, the same
+          re-staging rule the pooled fresh path follows.
+
+        Outputs return host-assembled through the pool's overlapped
+        readback windows (the round-8 trade: cross-device parallelism
+        for device residency of the OUTPUT; adoption in
+        ``ops/pipeline.py`` recovers output residency for chained
+        epochs)."""
+        nb = frame.num_blocks
+        sizes = frame.block_sizes
+        verb = "map_rows" if rows_level else "map_blocks"
+        # bucket targets still apply (device-side pad + slice); chunk
+        # streaming never does — pass all-None stream plans
+        pads = self._bucket_plan(
+            program, frame, infos, host_stage, rows_level, trim,
+            [None] * nb,
+        )
+        devices = cache.devices
+        pool = device_pool.PoolRun(
+            devices, cache.assignment, prefetch.prefetch_depth() or 1,
+            affinity=True,
+        )
+        session = fault_tolerance.frame_session(nb, verb=verb, pool=pool)
+        staged_cols = {
+            program.column_for_input(n) for n in (host_stage or {})
+        }
+        out_blocks: List[Optional[Dict[str, Any]]] = [None] * nb
+        hits = 0
+        restaged = 0
+        for bi in range(nb):
+            di = cache.assignment[bi]
+            di_eff = pool.effective_device(di) if session is not None else di
+            shard = cache.shard(bi)
+            block = dict(frame.block(bi))
+            used = False
+            if shard is not None and di_eff == di:
+                for cname, v in shard.items():
+                    if cname not in staged_cols:
+                        block[cname] = v
+                        used = True
+            if used:
+                hits += 1
+                observability.note_cache_shard_hit()
+            else:
+                restaged += 1
+                if session is not None and shard is not None:
+                    session.note_cache_restage()
+            n_rows = sizes[bi]
+            if session is not None:
+                staged = (
+                    self._device_inputs(
+                        program, block, infos, host_stage,
+                        pad_to=pads[bi], device=devices[di_eff],
+                    )
+                    if used
+                    else None
+                )
+                outs = self._run_block_ft(
+                    session, program, frame, bi, infos, host_stage,
+                    pads[bi], rows_level, trim, False, staged,
+                    devices=devices, pool=pool, di=di,
+                )
+                del staged
+                di_eff = pool.effective_device(di)
+            else:
+                inputs = self._device_inputs(
+                    program, block, infos, host_stage,
+                    pad_to=pads[bi], device=devices[di_eff],
+                )
+                if rows_level:
+                    outs = self._rows_run(program, False)(inputs)
+                else:
+                    outs = self._run_block_program(program, inputs)
+                del inputs
+                if pads[bi] is not None:
+                    outs = {k: v[:n_rows] for k, v in outs.items()}
+            self._check_block_outputs(program, outs, n_rows, rows_level, trim)
+            pool.submit(bi, di_eff, n_rows, outs, out_blocks)
+        pool.finish(out_blocks)
+        span.annotate("device_pool", pool.record())
+        fc = cache.record()
+        fc["shard_hits"] = hits
+        fc["restaged_blocks"] = restaged
+        span.annotate("frame_cache", fc)
+        if session is not None and session.events():
+            span.annotate("fault_tolerance", session.record())
+        return out_blocks
+
     def _empty_map_outputs(
         self,
         program: Program,
@@ -1542,14 +1678,21 @@ class Executor:
                 )
         # mirror the dispatch exactly: blocks the runtime would STREAM
         # compile chunk-sized executables on first use (documented gap) —
-        # warming their whole-block signature would be dead weight
-        plans = [
-            self._stream_plan(
-                program, frame.block(bi), infos, host_stage,
-                check_independence=not rows_level,
-            )
-            for bi in range(frame.num_blocks)
-        ]
+        # warming their whole-block signature would be dead weight.  A
+        # sharded-cached frame never streams (its bytes are already in
+        # HBM), so its plan is all-None like the dispatch's.
+        cache = frame_cache.active_cache(frame)
+        plans = (
+            [None] * frame.num_blocks
+            if cache is not None
+            else [
+                self._stream_plan(
+                    program, frame.block(bi), infos, host_stage,
+                    check_independence=not rows_level,
+                )
+                for bi in range(frame.num_blocks)
+            ]
+        )
         pads = self._bucket_plan(
             program, frame, infos, host_stage, rows_level, False, plans
         )
@@ -1567,8 +1710,14 @@ class Executor:
             # compiling) — warming any signature would be dead weight
             return []
         # match the runtime's donation choice (_map_dispatch): donated
-        # entries lower to a different persistent-cache key
-        donate = prefetch.donate_inputs() and self._frame_fresh(frame)
+        # entries lower to a different persistent-cache key.  Cached
+        # frames (sharded or single-device) never donate — shards and
+        # resident columns are shared state
+        donate = (
+            prefetch.donate_inputs()
+            and self._frame_fresh(frame)
+            and cache is None
+        )
         run = (
             self._rows_run(program, donate)
             if rows_level
@@ -1593,26 +1742,33 @@ class Executor:
                 raw, specs, ("aot", bool(rows_level), donate)
             )
             fps.append(fn.fingerprint)
-        # device-pool priming: when the pool would engage for this frame,
-        # execute the SAME entry the dispatch loop uses once per (bucketed
-        # size, device) on zero-filled blocks, so the first real dispatch
-        # on EVERY pool device is a jit-cache hit (backed by the
-        # persistent cache: the per-device compile is a disk fetch in a
-        # warmed process).  Execution, not just lowering: jax keys
-        # executables by input placement, and running the entry on the
-        # target device is the one way to seed that key.  Programs are
-        # pure by contract, so a zeros dispatch has no effect beyond the
-        # caches; trace counting is suppressed (warmup is analysis).
-        pool_devs = (
-            device_pool.pool_devices()
-            if (
-                self.supports_device_pool
-                and self._frame_fresh(frame)
-                and frame.num_blocks > 1
-            )
-            else []
-        )
-        if len(pool_devs) >= 2:
+        # (bucket size, device) grid priming: execute the SAME entry the
+        # dispatch loop uses once per (bucketed size, device) on
+        # zero-filled blocks, so the first real dispatch on EVERY target
+        # device is a jit-cache hit (backed by the persistent cache: the
+        # per-device compile is a disk fetch in a warmed process).
+        # Execution, not just lowering: jax keys executables by input
+        # placement, and running the entry on the target device is the
+        # one way to seed that key.  Programs are pure by contract, so a
+        # zeros dispatch has no effect beyond the caches; trace counting
+        # is suppressed (warmup is analysis).  The grid's device axis
+        # (round 10): a host-fresh pool-eligible frame primes every pool
+        # device; a SHARDED-cached frame primes its shard devices; a
+        # single-device cached frame primes its resident device — so a
+        # cached loop's first epoch pays no compile either.
+        if cache is not None:
+            prime_devs = [
+                cache.devices[di] for di in sorted(set(cache.assignment))
+            ]
+        elif not self._frame_fresh(frame):
+            dev = self._resident_device(frame)
+            prime_devs = [dev] if dev is not None else []
+        elif self.supports_device_pool and frame.num_blocks > 1:
+            pool_devs = device_pool.pool_devices()
+            prime_devs = pool_devs if len(pool_devs) >= 2 else []
+        else:
+            prime_devs = []
+        if prime_devs:
             for n_rows in exec_sizes:
                 zeros = {}
                 for n in program.input_names:
@@ -1624,7 +1780,7 @@ class Executor:
                     zeros[n] = np.zeros(
                         (n_rows,) + tuple(cell), st.np_dtype
                     )
-                for dev in pool_devs:
+                for dev in prime_devs:
                     inputs = {
                         k: jax.device_put(v, dev) for k, v in zeros.items()
                     }
@@ -1632,6 +1788,30 @@ class Executor:
                         out = run(inputs)
                     jax.block_until_ready(out)
         return fps
+
+    def _resident_device(self, frame: TensorFrame):
+        """The device a single-device cached frame's columns live on
+        (first device column wins; columns are co-located by
+        ``cache()``), or None for host frames.  Tolerates both jax API
+        generations (``.devices()`` set vs ``.device``)."""
+        for ci in frame.schema:
+            data = frame.column(ci.name).data
+            if not isinstance(data, jax.Array):
+                continue
+            devs = getattr(data, "devices", None)
+            if callable(devs):
+                try:
+                    ds = devs()
+                    if ds:
+                        return next(iter(ds))
+                except Exception:
+                    pass
+            dev = getattr(data, "device", None)
+            try:
+                return dev() if callable(dev) else dev
+            except Exception:
+                return None
+        return None
 
     def _column_array(
         self, frame: TensorFrame, col_name: str, ci: ColumnInfo
@@ -1806,6 +1986,17 @@ class Executor:
         session = fault_tolerance.frame_session(
             frame.num_blocks, verb="reduce"
         )
+        # sharded frame cache: per-block partials fold on each block's
+        # RESIDENT device (no H2D for resident shards), then hop — one
+        # reduced cell per base — to ONE combine device in block order,
+        # so the caller's final combine keeps the exact serial fold
+        # shape (bit-identity, like the round-8 pooled partials)
+        cache = frame_cache.active_cache(frame)
+        if cache is not None and len(nonempty) > 1:
+            return self._reduce_partials_sharded(
+                run, bases, sts, frame, span, cache, session, sizes,
+                nonempty,
+            )
         pool_devs = (
             device_pool.pool_devices()
             if (
@@ -1903,6 +2094,92 @@ class Executor:
                 sum(l.stats["wait_s"] for l in lanes),
             ),
         )
+        if session is not None and session.events():
+            span.annotate("fault_tolerance", session.record())
+        span.mark("dispatch_partials")
+        return partials
+
+    def _reduce_partials_sharded(
+        self, run, bases, sts, frame, span, cache, session, sizes, nonempty
+    ) -> List[Dict[str, jnp.ndarray]]:
+        """Affinity partials for the reduce verbs over a sharded-cached
+        frame: each nonempty block's fold runs on its resident device
+        (shards never donate; evicted blocks re-stage from the host copy
+        inline), every partial then moves async to ONE combine device in
+        block order.  Retries and quarantine redirects re-stage from the
+        authoritative host columns on the current effective device."""
+        devices = cache.devices
+        pool = device_pool.PoolRun(
+            devices,
+            [cache.assignment[bi] for bi in nonempty],
+            prefetch.prefetch_depth() or 1,
+            affinity=True,
+        )
+        if session is not None:
+            session.pool = pool
+        combine = devices[0]
+        partials: List[Dict[str, jnp.ndarray]] = []
+        hits = 0
+        for bi in nonempty:
+            di = cache.assignment[bi]
+            shard0 = cache.shard(bi)
+            has_shard = shard0 is not None and any(b in shard0 for b in bases)
+            # whether the attempt that SUCCEEDED read the shard — a
+            # retried block re-stages from host, and the hit counter
+            # must not claim otherwise
+            used = {"v": False}
+
+            def stage(dev_i, use_shard, _bi=bi, _shard=shard0):
+                block = frame.block(_bi)
+                shard = _shard if use_shard else None
+                return {
+                    b: self._device_value(
+                        shard[b]
+                        if shard is not None and b in shard
+                        else block[b],
+                        sts[b],
+                        device=devices[dev_i],
+                    )
+                    for b in bases
+                }
+
+            if session is None:
+                used["v"] = has_shard
+                p = run(stage(di, True))
+                di_eff = di
+            else:
+
+                def attempt(
+                    a, dev_i, _stage=stage, _di=di, _has=has_shard,
+                    _used=used,
+                ):
+                    # only attempt 0 on the home device may read the
+                    # shard; every retry / redirect re-stages from host
+                    u = a == 0 and dev_i == _di and _has
+                    _used["v"] = u
+                    return run(_stage(dev_i, u))
+
+                p = session.run(
+                    bi,
+                    sizes[bi],
+                    attempt,
+                    device=lambda _di=di: pool.effective_device(_di),
+                )
+                di_eff = pool.effective_device(di)
+                if has_shard and not used["v"]:
+                    session.note_cache_restage()
+            if used["v"]:
+                hits += 1
+                observability.note_cache_shard_hit()
+            pool.note_dispatch(di_eff, sizes[bi])
+            # async hop to the combine device: one reduced cell per base
+            partials.append(
+                {b: jax.device_put(p[b], combine) for b in bases}
+            )
+        span.annotate("device_pool", pool.record())
+        fc = cache.record()
+        fc["shard_hits"] = hits
+        span.annotate("frame_cache", fc)
         if session is not None and session.events():
             span.annotate("fault_tolerance", session.record())
         span.mark("dispatch_partials")

@@ -153,6 +153,10 @@ class FrameRetrySession:
         self._sleep = sleep
         self.retries = 0
         self.oom_splits = 0
+        # sharded-cache recovery (round 10): blocks whose resident shard
+        # could not be used (home device quarantined / shard evicted
+        # mid-run) and were rebuilt from the authoritative host copy
+        self.cache_restages = 0
 
     # -- per-block loop ------------------------------------------------------
 
@@ -243,12 +247,19 @@ class FrameRetrySession:
         self.oom_splits += 1
         observability.note_oom_split()
 
+    def note_cache_restage(self) -> None:
+        """One cached block rebuilt from its authoritative host copy
+        because its resident shard was unusable (quarantined home
+        device, or evicted between scheduling and dispatch)."""
+        self.cache_restages += 1
+
     def events(self) -> bool:
         """Whether anything recovery-worthy happened (gates the span
         annotation so fault-free spans keep their exact prior shape)."""
         return bool(
             self.retries
             or self.oom_splits
+            or self.cache_restages
             or (self.pool is not None and self.pool.quarantined)
         )
 
@@ -259,6 +270,8 @@ class FrameRetrySession:
             "oom_splits": self.oom_splits,
             "retry_budget_per_block": self.per_block,
         }
+        if self.cache_restages:
+            rec["cache_restages"] = self.cache_restages
         if self.pool is not None:
             rec["failures_per_device"] = list(self.pool.failures)
             rec["quarantined_devices"] = sorted(self.pool.quarantined)

@@ -69,6 +69,7 @@ from . import (
     bucketing,
     device_pool,
     fault_tolerance,
+    frame_cache,
     prefetch,
     segment_compile,
     validation,
@@ -639,14 +640,24 @@ class Pipeline:
             return frame
 
     def _pool_plan(self):
-        """``(devices, entry layout)`` for a pooled run, or None to take
-        the fused whole-frame dispatch.  Pooling needs: a map-terminal
-        chain (map stages only), no mesh engine, >= 2 blocks, >= 2 pool
-        devices, and a fully host-resident entry set (a cached frame's
-        columns live on ONE device; splitting them would shuffle HBM).
-        The knob and layout are resolved ONCE here and threaded through
-        the whole pooled run, so a mid-call env flip cannot yield an
-        inconsistent plan."""
+        """``(devices, entry layout, cache)`` for a pooled run, or None
+        to take the fused whole-frame dispatch.  Pooling needs: a
+        map-terminal chain (map stages only), no mesh engine, >= 2
+        blocks, and a fully host-resident entry set.  Two ways in:
+
+        * a host-fresh frame with >= 2 pool devices — the round-8 plan
+          (per-device staging lanes, donated entry buffers);
+        * a SHARDED-cached frame (``ops/frame_cache.py``; its host
+          columns stay authoritative, so the entry set still reads as
+          host-resident) — the run follows the cache's own device set
+          and block-affinity assignment, pool knob or not, with no
+          lanes, no donation and no H2D for resident shards.
+
+        A single-device (round-2) cached frame still bypasses pooling:
+        its columns live on ONE device and splitting them would shuffle
+        HBM.  The knob and layout are resolved ONCE here and threaded
+        through the whole pooled run, so a mid-call env flip cannot
+        yield an inconsistent plan."""
         if (
             self._row_stage
             or self._mesh_mode
@@ -657,13 +668,16 @@ class Pipeline:
             )
         ):
             return None
-        devices = device_pool.pool_devices()
+        cache = frame_cache.active_cache(self._frame)
+        devices = (
+            cache.devices if cache is not None else device_pool.pool_devices()
+        )
         if len(devices) < 2:
             return None
         layout, all_host = self._entry_layout()
         if not layout or not all_host:
             return None
-        return devices, layout
+        return devices, layout, cache
 
     def _pool_pads(self, sizes: List[int], layout) -> List[Optional[int]]:
         """Bucket targets for the pooled per-block chain (engine
@@ -725,19 +739,35 @@ class Pipeline:
             self._pool_proofs[key] = ok
         return targets if self._pool_proofs[key] else none
 
-    def _run_pooled(self, devices, layout):
+    def _run_pooled(self, devices, layout, cache=None):
         """Map-terminal chain over the device pool: the fused per-block
         body (:meth:`_block_chain`) dispatches once per block on the
         block's assigned device, with per-device staging lanes and the
         bounded overlapped-readback window — the pipeline face of the
         engine's ``_map_dispatch_pool``.  Entry buffers are fresh host
         slices staged per block, so they donate exactly like the fused
-        path's entry columns."""
+        path's entry columns.
+
+        ``cache`` (round 10, ``ops/frame_cache.py``): a sharded-cached
+        entry frame runs AFFINITY dispatch instead — each block executes
+        on the device already holding its shard, with no staging lanes,
+        no donation (shards are shared state) and zero H2D for resident
+        shards; evicted blocks and retry/quarantine recovery re-stage
+        from the authoritative host columns.
+
+        Donation-adoption: when sharding is on (entry cache present, or
+        ``TFS_CACHE_SHARDED`` resolves devices), each block's OUTPUT
+        buffers — already living on the block's execution device — are
+        adopted as the cached shards of the result frame, so the next
+        epoch of an iterative chain (``run`` feeding ``run``) starts
+        sharded-cached and stages nothing.  The overlapped D2H readback
+        still assembles the authoritative host columns; adopted shards
+        are bytes-accounted against ``TFS_HBM_BUDGET``."""
         frame = self._frame
         with observability.verb_span(
             "pipeline", frame.num_rows, frame.num_blocks
         ) as span:
-            donate = prefetch.donate_inputs()
+            donate = prefetch.donate_inputs() and cache is None
             if donate not in self._pool_compiled:
                 self._pool_compiled[donate] = jax.jit(
                     lambda blk, params_list: self._block_chain(
@@ -750,9 +780,14 @@ class Pipeline:
             span.annotate("donate_entry", donate)
             sizes = frame.block_sizes
             nb = frame.num_blocks
-            assignment = device_pool.assign(sizes, len(devices))
+            assignment = (
+                list(cache.assignment)
+                if cache is not None
+                else device_pool.assign(sizes, len(devices))
+            )
             pool = device_pool.PoolRun(
-                devices, assignment, prefetch.prefetch_depth() or 1
+                devices, assignment, prefetch.prefetch_depth() or 1,
+                affinity=cache is not None,
             )
             # block-level fault tolerance (ops/fault_tolerance.py): the
             # pooled per-block chain retries exactly like the eager map
@@ -779,17 +814,104 @@ class Pipeline:
                         a = a.astype(dt)
                     if pads[bi] is not None:
                         a = bucketing.pad_rows(a, pads[bi])
+                    observability.note_h2d_bytes(a.nbytes)
                     staged[name] = jax.device_put(a, dev)
                 return staged
 
-            lanes = device_pool.lanes(devices, assignment, stage_block)
-            lane_iters = [iter(l) for l in lanes]
-            lane_dead = [False] * len(devices)
+            def stage_cached(bi, dev_i):
+                """Entry dict for one block of the sharded-cached frame:
+                resident shard columns pass through on their device
+                (bucket-padded device-side when needed); missing columns
+                and evicted blocks re-stage from the host copy."""
+                shard = (
+                    cache.shard(bi) if dev_i == assignment[bi] else None
+                )
+                lo, hi = offsets[bi], offsets[bi + 1]
+                staged = {}
+                used = False
+                for name, (data, dt) in layout.items():
+                    v = shard.get(name) if shard is not None else None
+                    if v is not None:
+                        if pads[bi] is not None:
+                            v = bucketing.pad_rows(v, pads[bi])
+                        staged[name] = v
+                        used = True
+                        continue
+                    a = host_cols[name][lo:hi]
+                    if a.dtype != dt:
+                        a = a.astype(dt)
+                    if pads[bi] is not None:
+                        a = bucketing.pad_rows(a, pads[bi])
+                    observability.note_h2d_bytes(a.nbytes)
+                    staged[name] = jax.device_put(a, devices[dev_i])
+                return staged, used
+
+            if cache is None:
+                lanes = device_pool.lanes(devices, assignment, stage_block)
+                lane_iters = [iter(l) for l in lanes]
+                lane_dead = [False] * len(devices)
+            else:
+                lanes = []
             params_list = self._params_list()
             out_blocks: List[Optional[Dict[str, Any]]] = [None] * nb
+            # donation-adoption: collect each block's device-resident
+            # outputs when sharding is on (the result frame adopts them)
+            adopt_outs = (
+                [None] * nb
+                if (
+                    cache is not None
+                    or len(frame_cache.shard_devices(None)) >= 2
+                )
+                else None
+            )
+            eff_assign: List[int] = []
+            shard_hits = 0
             for bi in range(nb):
                 di = assignment[bi]
-                if session is None:
+                if cache is not None:
+                    di_eff = pool.effective_device(di) if session else di
+                    staged, used = (
+                        stage_cached(bi, di_eff)
+                        if (session is None or di_eff == di)
+                        else (None, False)
+                    )
+                    if used:
+                        shard_hits += 1
+                        observability.note_cache_shard_hit()
+                    elif session is not None and di_eff != di:
+                        session.note_cache_restage()
+                    if session is None:
+                        outs = run(staged, params_list)
+                        del staged
+                    else:
+                        holder = {"v": staged}
+                        del staged
+
+                        def attempt(a, dev_i, _bi=bi, _h=holder, _di=di):
+                            # attempt 0 may consume the shard-backed
+                            # entries; every retry (and any quarantine
+                            # redirect) re-stages from the authoritative
+                            # host columns on the CURRENT device
+                            ins = (
+                                _h.pop("v", None)
+                                if (a == 0 and dev_i == _di)
+                                else None
+                            )
+                            _h.clear()
+                            if ins is None:
+                                ins = stage_block(_bi, devices[dev_i])
+                            return run(ins, params_list)
+
+                        outs = session.run(
+                            bi,
+                            sizes[bi],
+                            attempt,
+                            device=lambda _di=di: pool.effective_device(
+                                _di
+                            ),
+                        )
+                        di_eff = pool.effective_device(di)
+                elif session is None:
                     staged = next(lane_iters[di])
                     outs = run(staged, params_list)
                     del staged
@@ -827,6 +949,9 @@ class Pipeline:
                     # bucket-padded chain: slice the pad rows back off
                     # (the _pool_pads proof guarantees real rows' values)
                     outs = {k: v[: sizes[bi]] for k, v in outs.items()}
+                if adopt_outs is not None:
+                    adopt_outs[bi] = outs
+                eff_assign.append(di_eff)
                 pool.submit(bi, di_eff, sizes[bi], outs, out_blocks)
             pool.finish(out_blocks)
             span.annotate(
@@ -842,7 +967,8 @@ class Pipeline:
             out_frame = TensorFrame.from_blocks(out_blocks)
             # host-only / ragged source columns pass through unchanged when
             # the chain preserves row identity (no trim stage) — same rule
-            # as the fused path
+            # as the fused path.  Rebuild BEFORE adoption: the adopted
+            # cache must ride the frame object actually returned.
             if not any(s.trim for s in self._stages):
                 extra = [
                     c
@@ -854,6 +980,22 @@ class Pipeline:
                     out_frame = TensorFrame(
                         list(out_frame.columns) + extra, out_frame.offsets
                     )
+            adopted = (
+                frame_cache.adopt(out_frame, devices, eff_assign, adopt_outs)
+                if adopt_outs is not None
+                else None
+            )
+            fc_rec: Dict[str, Any] = {}
+            if cache is not None:
+                fc_rec = cache.record()
+                fc_rec["shard_hits"] = shard_hits
+            if adopted is not None:
+                fc_rec["adopted_blocks"] = adopted.resident_blocks()
+                fc_rec["adopted_bytes_per_device"] = (
+                    adopted.resident_bytes_per_device()
+                )
+            if fc_rec:
+                span.annotate("frame_cache", fc_rec)
             return out_frame
 
     def _entry_layout(self) -> Tuple[Dict[str, Any], bool]:

@@ -253,10 +253,14 @@ def stage_columns(
     the per-column transfers of a multi-column frame queue on the link
     together instead of being issued lazily by the consuming jit call.
     Device-resident values pass through untouched."""
+    from .. import observability
+
     staged = {}
     for name, arr in cols.items():
         if isinstance(arr, jax.Array):
             staged[name] = arr
         else:
-            staged[name] = jax.device_put(np.asarray(arr), device)
+            host = np.asarray(arr)
+            observability.note_h2d_bytes(host.nbytes)
+            staged[name] = jax.device_put(host, device)
     return staged

@@ -256,8 +256,10 @@ def test_pooled_donation_safety(monkeypatch):
     np.testing.assert_array_equal(
         np.asarray(frame.column("x").data), before
     )
-    # cached (device-resident) frame: the pool must not engage
-    cached = frame.cache()
+    # single-device cached frame (sharded=False): the pool must not
+    # engage — its columns are shared state on ONE device, and donating
+    # or splitting them would corrupt/shuffle HBM
+    cached = frame.cache(sharded=False)
     obs.enable()
     try:
         c0 = obs.counters()
@@ -270,6 +272,22 @@ def test_pooled_donation_safety(monkeypatch):
     assert d["pool_blocks"] == 0, d
     assert "device_pool" not in span
     assert span["prefetch"]["donate"] is False
+    # DEFAULT cache() while the pool is active shards (round 10,
+    # ops/frame_cache.py): the affinity dispatch pools every block on
+    # its resident device — zero H2D, never donating, same bytes
+    sharded = frame.cache()
+    obs.enable()
+    try:
+        c0 = obs.counters()
+        out = np.asarray(tfs.map_blocks(prog, sharded).column("y").data)
+        d = obs.counters_delta(c0)
+        span = obs.last_spans(1)[0]
+    finally:
+        obs.disable()
+    np.testing.assert_array_equal(out, first)
+    assert d["pool_blocks"] == frame.num_blocks, d
+    assert d["h2d_bytes_staged"] == 0, d
+    assert span["device_pool"]["affinity"] is True
 
 
 def test_pooled_warmup_primes_every_device(monkeypatch):

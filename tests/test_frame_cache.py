@@ -1,0 +1,456 @@
+"""Sharded HBM frame cache (``ops/frame_cache.py``, round 10).
+
+The contract under test: ``cache(sharded=True)`` places each block's
+column slices on that block's pool device (the SAME deterministic
+least-loaded plan the device-pool scheduler computes), the engine's
+affinity dispatch runs every map verb and the pooled reduce partials on
+the device already holding the data — zero H2D, **bit-identical** to the
+host and single-device-cached paths — the LRU ``TFS_HBM_BUDGET`` evicts
+back to the authoritative host copy, and pooled pipeline chains ADOPT
+their per-device outputs as the successor frame's shards (an N-epoch
+loop stages once).
+
+Tests named ``test_pooled_*`` run process-isolated on the forced
+8-device CPU mesh (tests/conftest.py), like the device-pool suite; the
+rest are knob/validation logic and safe in-process.
+"""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import observability as obs
+from tensorframes_tpu.ops import frame_cache
+from tensorframes_tpu.ops.pipeline import pipeline
+from tensorframes_tpu.schema import SchemaError
+
+
+def _frame(n=120, nb=6, seed=0, d=4, extra=None):
+    rng = np.random.RandomState(seed)
+    data = {
+        "x": rng.rand(n, d).astype(np.float32),
+        "k": (np.arange(n) % 5).astype(np.int32),
+    }
+    data.update(extra or {})
+    return tfs.analyze(tfs.TensorFrame.from_arrays(data, num_blocks=nb))
+
+
+# ---------------------------------------------------------------------------
+# knob / validation logic (no multi-device dispatch: safe in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_hbm_budget_parse(monkeypatch):
+    for raw, want in [
+        ("", 0),
+        ("0", 0),
+        ("1024", 1024),
+        ("64k", 64 << 10),
+        ("2M", 2 << 20),
+        ("1G", 1 << 30),
+        ("1.5K", 1536),
+        ("banana", 0),  # malformed -> unlimited, warned once
+    ]:
+        monkeypatch.setenv("TFS_HBM_BUDGET", raw)
+        assert frame_cache.hbm_budget() == want, raw
+
+
+def test_shard_devices_knob(monkeypatch):
+    # pool pinned off (conftest) + auto -> no sharding
+    monkeypatch.setenv("TFS_DEVICE_POOL", "0")
+    monkeypatch.setenv("TFS_CACHE_SHARDED", "auto")
+    assert frame_cache.shard_devices(None) == []
+    # off beats everything
+    monkeypatch.setenv("TFS_CACHE_SHARDED", "0")
+    assert frame_cache.shard_devices(None) == []
+    # always shards over local devices even with the pool knob off
+    monkeypatch.setenv("TFS_CACHE_SHARDED", "always")
+    assert len(frame_cache.shard_devices(None)) == len(jax.local_devices())
+    # explicit argument overrides the env
+    assert frame_cache.shard_devices(False) == []
+    monkeypatch.setenv("TFS_CACHE_SHARDED", "off")
+    assert len(frame_cache.shard_devices(True)) == len(jax.local_devices())
+    # pool on + auto follows the pool
+    monkeypatch.setenv("TFS_CACHE_SHARDED", "auto")
+    monkeypatch.setenv("TFS_DEVICE_POOL", "3")
+    assert len(frame_cache.shard_devices(None)) == 3
+
+
+def test_cache_default_path_unchanged(monkeypatch):
+    """With the pool pinned off and no explicit request, ``cache()`` keeps
+    the round-2 single-device layout: device-resident columns, no shard
+    attachment."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "0")
+    monkeypatch.delenv("TFS_CACHE_SHARDED", raising=False)
+    frame = _frame(n=24, nb=2)
+    cached = frame.cache()
+    assert cached.column("x").is_device
+    assert frame_cache.active_cache(cached) is None
+
+
+def test_cache_strict_and_one_shot_skip_log(caplog):
+    frame = tfs.TensorFrame.from_arrays(
+        {
+            "x": np.arange(8, dtype=np.float32),
+            "r": [np.zeros((i + 1,), np.float32) for i in range(8)],
+        },
+        num_blocks=2,
+    )
+    assert frame.column("r").is_ragged
+    with pytest.raises(SchemaError, match="'r'|r: ragged"):
+        frame.cache(strict=True)
+    with pytest.raises(SchemaError, match="strict"):
+        frame.cache(strict=True)
+    # non-strict: cached, with ONE warning naming the column and reason
+    with caplog.at_level(logging.WARNING, logger="tensorframes_tpu.frame"):
+        frame.cache()
+        frame.cache()  # second call: no new record for the same set
+    hits = [
+        r
+        for r in caplog.records
+        if "cache()" in r.getMessage() and "r: ragged" in r.getMessage()
+    ]
+    assert len(hits) == 1, [r.getMessage() for r in caplog.records]
+
+
+def test_budget_lru_accounting_logic():
+    """Pure-logic LRU check on the budget manager (no devices): oldest
+    entry evicts first, touch refreshes recency, release refunds."""
+    mgr = frame_cache._HbmBudget()
+
+    class _FakeCache:
+        def __init__(self, n):
+            self.blocks = [object()] * n
+            self.nbytes = [0] * n
+            self.evicted = []
+
+        def evict(self, bi):
+            self.evicted.append(bi)
+
+    os.environ["TFS_HBM_BUDGET"] = "100"
+    try:
+        c = _FakeCache(4)
+        assert mgr.charge(c, 0, 40)
+        assert mgr.charge(c, 1, 40)
+        mgr.touch(c, 0)  # block 1 is now LRU
+        assert mgr.charge(c, 2, 40)
+        assert c.evicted == [1]
+        # a shard bigger than the whole budget is refused outright
+        assert not mgr.charge(c, 3, 200)
+        mgr.release(c)
+        assert mgr.total_bytes == 0
+    finally:
+        os.environ.pop("TFS_HBM_BUDGET")
+
+
+# ---------------------------------------------------------------------------
+# sharded dispatch (process-isolated: test_pooled_*)
+# ---------------------------------------------------------------------------
+
+
+def _six_verbs(frame):
+    mapb = tfs.Program.wrap(
+        lambda x: {"y": jnp.tanh(x) * 2.0 + x}, fetches=["y"]
+    )
+    mapr = tfs.Program.wrap(lambda x: {"r": x.sum() + x[0]}, fetches=["r"])
+    trimmed = tfs.Program.wrap(
+        lambda x: {"s": x.sum(0, keepdims=True)}, fetches=["s"]
+    )
+    pair = tfs.Program.wrap(
+        lambda x_1, x_2: {"x": x_1 + 3.0 * x_2}, fetches=["x"]
+    )
+    blockred = tfs.Program.wrap(
+        lambda x_input: {"x": (x_input * 1.3).sum(0)}, fetches=["x"]
+    )
+    agg = tfs.Program.wrap(
+        lambda x_input: {"x": x_input.sum(0)}, fetches=["x"]
+    )
+    out = {}
+    out["map_blocks"] = np.asarray(
+        tfs.map_blocks(mapb, frame).column("y").data
+    )
+    out["map_rows"] = np.asarray(tfs.map_rows(mapr, frame).column("r").data)
+    out["trimmed"] = np.asarray(
+        tfs.map_blocks(trimmed, frame, trim=True).column("s").data
+    )
+    out["reduce_rows_tree"] = tfs.reduce_rows(pair, frame, mode="tree")["x"]
+    out["reduce_rows_seq"] = tfs.reduce_rows(pair, frame, mode="sequential")[
+        "x"
+    ]
+    out["reduce_blocks"] = tfs.reduce_blocks(blockred, frame)["x"]
+    a = tfs.aggregate(agg, frame.group_by("k"))
+    out["aggregate_k"] = np.asarray(a.column("k").data)
+    out["aggregate_x"] = np.asarray(a.column("x").data)
+    return out
+
+
+def test_pooled_cached_six_verbs_bit_identical(monkeypatch):
+    """All six verbs return EXACTLY the same bytes on the host path, the
+    single-device cached path, the sharded-cached path, and the
+    sharded-cached path under the device pool WITH fault injection —
+    the round-10 bit-identity matrix."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    frame = _frame()
+    base = _six_verbs(frame)
+
+    monkeypatch.setenv("TFS_DEVICE_POOL", "0")
+    serial_cached = _six_verbs(frame.cache(sharded=False))
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+
+    sharded = frame.cache(sharded=True)
+    assert frame_cache.active_cache(sharded) is not None
+    got = _six_verbs(sharded)
+
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", "6")
+    monkeypatch.setenv("TFS_BLOCK_BACKOFF_S", "0.001")
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:rate=0.3:seed=5")
+    chaotic = _six_verbs(sharded)
+    monkeypatch.setenv("TFS_FAULT_INJECT", "")
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", "0")
+
+    for name in base:
+        np.testing.assert_array_equal(
+            base[name], serial_cached[name], err_msg=f"serial-cached {name}"
+        )
+        np.testing.assert_array_equal(
+            base[name], got[name], err_msg=f"sharded {name}"
+        )
+        np.testing.assert_array_equal(
+            base[name], chaotic[name], err_msg=f"sharded+faults {name}"
+        )
+
+
+def test_pooled_cached_affinity_and_zero_h2d(monkeypatch):
+    """Affinity evidence: after ``cache(sharded=True)``, a map verb
+    stages ZERO host->device bytes, serves every block from its shard,
+    and executes each block on the device the assignment placed it on
+    (scheduler counters per device match the cache's own plan)."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    frame = _frame(n=160, nb=8)
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0}, fetches=["y"])
+    sharded = frame.cache(sharded=True)
+    cache = frame_cache.active_cache(sharded)
+    assert cache is not None and cache.resident_blocks() == 8
+    obs.enable()
+    try:
+        c0 = obs.counters()
+        out = tfs.map_blocks(prog, sharded)
+        np.asarray(out.column("y").data)
+        d = obs.counters_delta(c0)
+        span = obs.last_spans(1)[0]
+    finally:
+        obs.disable()
+    assert d["h2d_bytes_staged"] == 0, d
+    assert d["cache_shard_hits"] == 8, d
+    assert d["pool_blocks"] == 8, d
+    pool = span["device_pool"]
+    assert pool["affinity"] is True
+    # blocks ran WHERE the shards live: per-device counts equal the
+    # cache assignment's histogram
+    want = [0] * len(cache.devices)
+    for di in cache.assignment:
+        want[di] += 1
+    assert pool["blocks_per_device"] == want
+    fc = span["frame_cache"]
+    assert fc["shard_hits"] == 8
+    assert fc["resident_blocks"] == 8
+    assert sum(fc["resident_bytes_per_device"]) > 0
+    # reduce partials pool too (affinity), combine staying serial-shaped
+    c0 = obs.counters()
+    tfs.reduce_blocks(
+        tfs.Program.wrap(
+            lambda x_input: {"x": x_input.sum(0)}, fetches=["x"]
+        ),
+        sharded,
+    )
+    d = obs.counters_delta(c0)
+    assert d["h2d_bytes_staged"] == 0, d
+    assert d["cache_shard_hits"] == 8, d
+
+
+def test_pooled_cached_lru_eviction_tiny_budget(monkeypatch):
+    """A tiny ``TFS_HBM_BUDGET`` keeps only the newest shards resident;
+    evicted blocks re-stage from the authoritative host copy (counted
+    H2D) and results stay bit-identical."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    frame = _frame()
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0}, fetches=["y"])
+    base = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    # one block = 20 rows x (4 f32 + 1 i32) = 400 bytes; fit ~2 blocks
+    monkeypatch.setenv("TFS_HBM_BUDGET", "900")
+    c0 = obs.counters()
+    sharded = frame.cache(sharded=True)
+    d = obs.counters_delta(c0)
+    cache = frame_cache.active_cache(sharded)
+    assert cache is not None
+    assert 0 < cache.resident_blocks() < frame.num_blocks
+    assert d["cache_evictions"] >= frame.num_blocks - cache.resident_blocks()
+    assert frame_cache.budget_bytes_resident() <= 900
+    c0 = obs.counters()
+    got = np.asarray(tfs.map_blocks(prog, sharded).column("y").data)
+    d = obs.counters_delta(c0)
+    np.testing.assert_array_equal(base, got)
+    # evicted blocks re-staged from host, resident ones did not
+    assert d["h2d_bytes_staged"] > 0
+    assert d["cache_shard_hits"] == cache.resident_blocks()
+
+
+def test_pooled_cached_adoption_across_epochs(monkeypatch):
+    """Donation-adoption: epoch 1 of a pooled map chain stages the frame
+    once; its output frame is born sharded-cached (the per-device output
+    buffers were adopted in place), so epochs 2..N stage ZERO bytes —
+    and every epoch's bytes match the serial chain.  The source frame
+    carries a RAGGED pass-through column: re-attaching it rebuilds the
+    output frame, and the adopted cache must ride the REBUILT frame
+    (regression: adoption once attached to the pre-rebuild object and
+    was silently lost)."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    frame = _frame(
+        n=96,
+        nb=6,
+        extra={"r": [np.zeros((i % 3 + 1,), np.float32) for i in range(96)]},
+    )
+    assert frame.column("r").is_ragged
+
+    def step(fr):
+        return (
+            pipeline(fr)
+            .map_rows(lambda x: {"x": x * 0.5 + 1.0})
+            .run()
+        )
+
+    # serial reference epochs
+    monkeypatch.setenv("TFS_DEVICE_POOL", "0")
+    monkeypatch.setenv("TFS_CACHE_SHARDED", "0")
+    ref = frame
+    refs = []
+    for _ in range(3):
+        ref = step(ref)
+        refs.append(np.asarray(ref.column("x").data))
+
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_CACHE_SHARDED", "auto")
+    cur = frame
+    h2d = []
+    for epoch in range(3):
+        c0 = obs.counters()
+        cur = step(cur)
+        d = obs.counters_delta(c0)
+        h2d.append(d["h2d_bytes_staged"])
+        np.testing.assert_array_equal(
+            refs[epoch], np.asarray(cur.column("x").data), err_msg=str(epoch)
+        )
+        cache = frame_cache.active_cache(cur)
+        assert cache is not None and cache.adopted, epoch
+        assert cache.resident_blocks() == cur.num_blocks
+    assert h2d[0] > 0  # epoch 1 stages the source frame
+    assert h2d[1] == 0 and h2d[2] == 0, h2d  # later epochs live in HBM
+
+    # iterate() on a sharded-cached frame: same results as the host
+    # frame (the scan stages the entry once and never re-stages between
+    # steps by construction)
+    monkeypatch.setenv("TFS_DEVICE_POOL", "0")
+    w0 = np.zeros((4,), np.float32)
+
+    def make_iter(fr):
+        prog = tfs.Program.wrap(
+            lambda x, w: {"g": (x + w).sum(0, keepdims=True)},
+            params={"w": w0},
+        )
+        return (
+            pipeline(fr)
+            .map_blocks(prog, trim=True)
+            .reduce_blocks(lambda g_input: {"g": g_input.sum(0)})
+            .then(lambda row, params: {"w": params["w"] - 0.01 * row["g"]})
+        )
+
+    fin_host, _ = make_iter(frame).iterate(4, carry={"w": "w"})
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    sharded = frame.cache(sharded=True)
+    fin_cached, _ = make_iter(sharded).iterate(4, carry={"w": "w"})
+    np.testing.assert_allclose(
+        np.asarray(fin_host["w"]), np.asarray(fin_cached["w"]), rtol=1e-6
+    )
+
+
+def test_pooled_cached_quarantine_restages_from_host(monkeypatch):
+    """A quarantined device holding cached shards: its blocks rebuild
+    from the authoritative host columns on a healthy device, results
+    bit-identical, recovery counted."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    frame = _frame()
+    prog = tfs.Program.wrap(lambda x: {"y": x * 2.0 + 1.0}, fetches=["y"])
+    base = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    sharded = frame.cache(sharded=True)
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", "3")
+    monkeypatch.setenv("TFS_BLOCK_BACKOFF_S", "0.001")
+    monkeypatch.setenv("TFS_QUARANTINE_AFTER", "1")
+    # device 0 fails its first attempt: quarantined, blocks re-staged
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:device=0:attempt=0")
+    c0 = obs.counters()
+    got = np.asarray(tfs.map_blocks(prog, sharded).column("y").data)
+    d = obs.counters_delta(c0)
+    np.testing.assert_array_equal(base, got)
+    assert d["devices_quarantined"] >= 1, d
+    assert d["block_retries"] >= 1, d
+    # the re-staged blocks paid H2D from the host copy
+    assert d["h2d_bytes_staged"] > 0, d
+    # the shards on healthy devices still served
+    assert d["cache_shard_hits"] >= 1, d
+
+
+def test_pooled_cached_uncache_roundtrip(monkeypatch):
+    """``uncache()`` on a sharded frame: host data unchanged, shards
+    released from the budget, and later verbs take the plain host path
+    (identical bytes)."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    frame = _frame(n=48, nb=4)
+    prog = tfs.Program.wrap(lambda x: {"y": x + 2.0}, fetches=["y"])
+    base = np.asarray(tfs.map_blocks(prog, frame).column("y").data)
+    before = frame_cache.budget_bytes_resident()
+    sharded = frame.cache(sharded=True)
+    cache = frame_cache.active_cache(sharded)
+    cache_bytes = sum(cache.nbytes)
+    assert cache_bytes > 0
+    assert frame_cache.budget_bytes_resident() >= before + cache_bytes
+    got = np.asarray(tfs.map_blocks(prog, sharded).column("y").data)
+    np.testing.assert_array_equal(base, got)
+    plain = sharded.uncache()
+    # this cache's bytes are refunded (other live caches may remain)
+    assert frame_cache.budget_bytes_resident() <= before
+    assert frame_cache.active_cache(plain) is None
+    assert frame_cache.active_cache(sharded) is None  # released in place
+    for col in ("x", "k"):
+        np.testing.assert_array_equal(
+            np.asarray(frame.column(col).data),
+            np.asarray(plain.column(col).data),
+        )
+    np.testing.assert_array_equal(
+        base, np.asarray(tfs.map_blocks(prog, plain).column("y").data)
+    )
+
+
+def test_pooled_cached_warmup_primes_shard_devices(monkeypatch):
+    """``warmup`` on a sharded-cached frame seeds the (bucket size,
+    device) executable grid: the first real affinity dispatch compiles
+    NOTHING."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_BLOCK_BUCKETS", "0")  # exact shapes: one size
+    frame = _frame(n=96, nb=6)  # 16 rows per block, even
+    program = tfs.Program.wrap(lambda x: {"y": x * 5.0}, fetches=["y"])
+    sharded = frame.cache(sharded=True)
+    fps = tfs.warmup(program, sharded)
+    assert fps
+    c0 = obs.counters()
+    out = tfs.map_blocks(program, sharded)
+    np.asarray(out.column("y").data)
+    d = obs.counters_delta(c0)
+    assert d["backend_compiles"] == 0, d
+    assert d["cache_shard_hits"] == 6, d
+    assert d["h2d_bytes_staged"] == 0, d

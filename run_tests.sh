@@ -46,6 +46,19 @@ for rs in "0.25:7" "0.4:11"; do
     python -m pytest tests/test_fault_tolerance.py -q
 done
 
+# Bridge tier: the serving-resilience tests (deadlines, admission shed,
+# idempotent retry, graceful drain) re-run process-isolated with the
+# TFS_BRIDGE_* knobs LIVE — the main suite below runs them too, but with
+# conftest pinning the env knobs off (tests pass explicit constructor
+# params there); this tier proves the env-knob wiring end to end.
+# Injection schedules are deterministic (method/call selectors), so a
+# failure here is a resilience bug, not flakiness.
+echo "== bridge tier (serving resilience, env knobs live) =="
+TFS_BRIDGE_MAX_INFLIGHT=8 TFS_BRIDGE_QUEUE_DEPTH=16 \
+TFS_BRIDGE_DRAIN_S=5 TFS_BRIDGE_MAX_FRAMES=256 \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_bridge_resilience.py tests/test_bridge.py -q
+
 echo "== pytest =="
 exec python -m pytest tests/ -q --ignore=tests/test_device_pool.py \
   --ignore=tests/test_frame_cache.py "$@"

@@ -19,9 +19,33 @@ frames and tensor programs to the TPU engine.
 Transport: newline-delimited JSON with base64 tensors — deliberately
 dependency-free and implementable from any language in an afternoon, like
 the Py4J text protocol it replaces.
+
+Round 11 makes the seam serving-grade: per-request deadlines cancelled
+cooperatively at block boundaries, bounded admission with ``ServerBusy``
+shedding, token-addressed sessions with idempotent retry after dropped
+replies, graceful drain, and an ungated ``health`` RPC (see
+``docs/RESILIENCE.md``).
 """
 
-from .client import BridgeClient
+from .client import (
+    BridgeClient,
+    BridgeError,
+    Cancelled,
+    DeadlineExceeded,
+    Draining,
+    RemoteFrame,
+    ServerBusy,
+)
 from .server import BridgeServer, serve
 
-__all__ = ["BridgeClient", "BridgeServer", "serve"]
+__all__ = [
+    "BridgeClient",
+    "BridgeError",
+    "BridgeServer",
+    "Cancelled",
+    "DeadlineExceeded",
+    "Draining",
+    "RemoteFrame",
+    "ServerBusy",
+    "serve",
+]

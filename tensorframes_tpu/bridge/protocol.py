@@ -2,8 +2,18 @@
 tensor frames.
 
 Each message is one JSON object per line (UTF-8).  Requests carry
-``{"id": n, "method": str, "params": {...}}``; responses carry
-``{"id": n, "result": ...}`` or ``{"id": n, "error": {"type", "message"}}``.
+``{"id": n, "method": str, "params": {...}}`` plus two OPTIONAL
+resilience keys (round 11): ``"deadline_ms"`` (the server cancels the
+verb at the next block boundary past it) and ``"idem"`` (an idempotency
+token the server dedups, making retries after a dropped reply
+exactly-once).  Responses carry ``{"id": n, "result": ...}`` or
+``{"id": n, "error": {"type", "message"}}``; structured refusals add
+``"code"`` (``deadline_exceeded`` / ``cancelled`` / ``server_busy`` /
+``draining`` / ``frame_cap_exceeded`` / ``unknown_session``) and
+code-specific fields (``retry_after_ms``, ``leaked_frame_ids``).  All
+round-11 keys are additive and ignorable — the framing is unchanged, so
+the protocol version stays 2 (the version exists to prevent *stream
+corruption*, not to gate optional envelope keys).
 Small tensors ride inline as ``{"__tensor__": {"dtype", "shape",
 "data"(b64)}}``; binary cells as ``{"__bytes__": b64}``.
 

@@ -7,41 +7,422 @@ one-shot RPCs: each verb call carries the accumulated builder state
 (GraphDef bytes, fetches, feed map, shape hints) in a single message.
 Frames stay server-side (only ids cross the wire) — the analog of DataFrames
 staying in the JVM while Python holds handles.
+
+Serving-grade resilience (round 11) — the reference's Py4J gateway simply
+blocks the driver thread per call; a front-end for real traffic cannot:
+
+* **Per-request deadlines**: a request's ``deadline_ms`` becomes a
+  ``cancellation.CancelScope`` active for the whole verb execution; the
+  engine checks it at every block boundary and retry attempt, so an
+  over-deadline verb raises a structured ``deadline_exceeded`` error at
+  the next boundary — completed blocks are intact, the session's frames
+  stay fully usable, and no worker thread is left stuck.
+* **Admission control + backpressure** (:class:`AdmissionGate`): at most
+  ``TFS_BRIDGE_MAX_INFLIGHT`` gated requests execute concurrently and at
+  most ``TFS_BRIDGE_QUEUE_DEPTH`` wait; past that the server sheds with
+  ``server_busy`` + ``retry_after_ms`` instead of queueing unboundedly.
+* **Sessions survive connections**: a client that says ``hello`` gets a
+  reattachable session token, so a dropped connection does not destroy
+  its frames; verb requests carry an idempotency token the session
+  dedups (bounded LRU), so a retried request after a dropped reply is
+  served the original outcome and never double-executes.
+* **Graceful drain**: :meth:`BridgeServer.close` rejects new admissions
+  with ``draining``, waits up to ``TFS_BRIDGE_DRAIN_S`` for in-flight
+  verbs, then cooperatively cancels stragglers through their cancel
+  scopes before releasing the socket.
+* **Health**: an ungated ``health`` RPC reports admission depth,
+  quarantined devices (``ops/device_pool`` history), and HBM budget
+  occupancy (``ops/frame_cache``) so clients can route around a sick
+  server.
+* **Chaos**: ``TFS_FAULT_INJECT`` bridge kinds (``bridge_stall`` /
+  ``bridge_delay`` / ``bridge_drop``) exercise all of the above
+  deterministically (``faults.maybe_inject_bridge``).
 """
 
 from __future__ import annotations
 
+import collections
+import logging
 import socket
 import socketserver
 import threading
+import time
+import uuid
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from .. import cancellation, faults, observability
+from ..envutil import env_float as _env_float, env_int as _env_int
 from ..analyze import analyze as _analyze
 from ..builder import OpBuilder
 from ..frame import TensorFrame
+from ..ops import device_pool, frame_cache
 from ..ops.engine import GroupedFrame
-from .protocol import decode_value, encode_value, read_message, write_message
+from .protocol import (
+    PROTOCOL_VERSION,
+    decode_value,
+    encode_value,
+    read_message,
+    write_message,
+)
+
+logger = logging.getLogger("tensorframes_tpu.bridge")
+
+# -- knobs (env defaults; per-server constructor overrides win) --------------
+
+ENV_MAX_INFLIGHT = "TFS_BRIDGE_MAX_INFLIGHT"
+ENV_QUEUE_DEPTH = "TFS_BRIDGE_QUEUE_DEPTH"
+ENV_DRAIN_S = "TFS_BRIDGE_DRAIN_S"
+ENV_MAX_FRAMES = "TFS_BRIDGE_MAX_FRAMES"
+ENV_SESSION_TTL_S = "TFS_BRIDGE_SESSION_TTL_S"
+
+DEFAULT_MAX_INFLIGHT = 8  # 0 = unlimited (admission gate off)
+DEFAULT_QUEUE_DEPTH = 16  # waiters allowed while inflight is full
+DEFAULT_DRAIN_S = 5.0
+DEFAULT_MAX_FRAMES = 0  # 0 = unlimited
+DEFAULT_SESSION_TTL_S = 300.0
+_IDEM_CACHE_CAP = 128  # replies remembered per session for dedup
+# ...bounded by BYTES too: cached replies pin full result payloads
+# (binary attachments included), so a count-only cap would let 128
+# multi-MB reduce results per session pile up on exactly the saturated
+# host admission control protects.  Oversized single results are not
+# retained — a retry of one gets a structured marker instead.
+_IDEM_CACHE_MAX_BYTES = 32 * 1024 * 1024
+_IDEM_ENTRY_MAX_BYTES = 8 * 1024 * 1024
+
+
+# methods that execute programs / move bulk data: these pass the
+# admission gate and run under a cancel scope.  Cheap control-plane
+# methods (ping, schema, release, hello, health, end_session) stay
+# ungated so clients can health-check and clean up even when the server
+# is saturated or draining.
+_GATED_METHODS = frozenset(
+    {
+        "create_frame",
+        "analyze",
+        "map_blocks",
+        "map_rows",
+        "aggregate",
+        "reduce_blocks",
+        "reduce_rows",
+        "collect",
+    }
+)
+
+# the complete ungated RPC surface, as an ALLOWLIST: anything not named
+# here or in _GATED_METHODS is refused, so a future public helper on
+# _Session can never silently become a remotely callable method (or
+# bypass the admission gate under its raw name, as run_df_verb would).
+# CONTRACT: ungated methods skip the idempotency dedup, so each must be
+# NATURALLY idempotent (release is a pop that ignores unknown ids) —
+# an ungated method with one-shot side effects would double-execute on
+# a client retry
+_UNGATED_METHODS = frozenset({"ping", "schema", "release"})
+
+# how long a retried request waits for its still-running original
+# execution's outcome before giving up with ``retry_conflict``
+_IDEM_WAIT_CAP_S = 600.0
+
+
+class BridgeServerError(RuntimeError):
+    """A structured server-side refusal: carried to the client as
+    ``{type, message, code, ...extra}`` so front-ends can branch on
+    ``code`` instead of parsing prose."""
+
+    code = "error"
+
+    def __init__(self, message: str, code: Optional[str] = None, **extra):
+        super().__init__(message)
+        if code is not None:
+            self.code = code  # instance override of the class default
+        self.extra = extra
+
+
+class ServerBusy(BridgeServerError):
+    """Admission gate full: shed instead of queueing unboundedly.  The
+    payload carries ``retry_after_ms`` — a deterministic backoff hint
+    scaled by the current queue depth."""
+
+    code = "server_busy"
+
+
+class Draining(BridgeServerError):
+    """The server is draining for shutdown; no new work is admitted."""
+
+    code = "draining"
+
+
+class FrameCapExceeded(BridgeServerError):
+    """The per-session frame registry hit ``TFS_BRIDGE_MAX_FRAMES`` —
+    almost always a client loop that never calls ``release``.  The
+    payload names the leaked frame ids."""
+
+    code = "frame_cap_exceeded"
+
+
+class ResultEncodingError(BridgeServerError):
+    """The verb EXECUTED but its result could not be serialized; the
+    message preserves that context (the original handler lost it —
+    round-11 satellite fix)."""
+
+    code = "result_encoding"
+
+
+class AdmissionGate:
+    """Bounded concurrent-execution gate for the serving path.
+
+    ``max_inflight`` gated requests execute at once; up to
+    ``queue_depth`` more wait — a waiter's deadline keeps ticking and
+    expires in place, and a NEW arrival never barges past waiters (the
+    fast path requires an empty queue, so freed slots go to the queue
+    first; wakeup order among waiters is the condition variable's).
+    Anything past both bounds is shed immediately with
+    :class:`ServerBusy`.  ``max_inflight=0`` disables the gate (every
+    request admits instantly — the single-tenant / test default pinned
+    by conftest)."""
+
+    def __init__(self, max_inflight: int, queue_depth: int):
+        self.max_inflight = max(0, int(max_inflight))
+        self.queue_depth = max(0, int(queue_depth))
+        self._cond = threading.Condition()
+        # FIFO tickets: freed slots are granted strictly in queue-arrival
+        # order, so a deadline-carrying waiter cannot be starved by later
+        # arrivals repeatedly winning the condition-wakeup race
+        self._waiters: "collections.deque" = collections.deque()
+        self.inflight = 0
+        self.queued = 0
+        self.draining = False
+        self.shed = 0
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                "inflight": self.inflight,
+                "queued": self.queued,
+                "max_inflight": self.max_inflight,
+                "queue_depth": self.queue_depth,
+                "draining": self.draining,
+                "shed_total": self.shed,
+            }
+
+    def _shed(self, exc: BridgeServerError) -> None:
+        self.shed += 1
+        observability.note_bridge_shed()
+        raise exc
+
+    def admit(self, scope: Optional[cancellation.CancelScope]) -> None:
+        """Admit the calling request or raise: :class:`Draining` while
+        draining, :class:`ServerBusy` when both the inflight and queue
+        bounds are full, ``DeadlineExceeded`` when the request's
+        deadline expires while queued."""
+        with self._cond:
+            if self.draining:
+                self._shed(Draining("server is draining; not admitting"))
+            # fast path only with an EMPTY queue: a new arrival taking a
+            # freed slot ahead of parked waiters would starve them (a
+            # deadline-carrying waiter could expire despite capacity
+            # turning over many times)
+            if self.max_inflight <= 0 or (
+                self.inflight < self.max_inflight and not self._waiters
+            ):
+                self.inflight += 1
+                return
+            if self.queued >= self.queue_depth:
+                self._shed(
+                    ServerBusy(
+                        f"admission gate full ({self.inflight} in flight, "
+                        f"{self.queued} queued; {ENV_MAX_INFLIGHT}="
+                        f"{self.max_inflight} {ENV_QUEUE_DEPTH}="
+                        f"{self.queue_depth})",
+                        retry_after_ms=25 * (self.queued + 1),
+                    )
+                )
+            ticket = object()
+            self._waiters.append(ticket)
+            self.queued += 1
+            try:
+                while True:
+                    if self.draining:
+                        self._shed(
+                            Draining("server began draining while queued")
+                        )
+                    if (
+                        self.inflight < self.max_inflight
+                        and self._waiters[0] is ticket
+                    ):
+                        # strictly FIFO: only the HEAD ticket may take a
+                        # freed slot, so later queuers cannot win the
+                        # wakeup race over an earlier deadline-bound one
+                        self.inflight += 1
+                        return
+                    remaining = (
+                        scope.time_remaining() if scope is not None else None
+                    )
+                    if remaining is not None and remaining <= 0:
+                        raise cancellation.DeadlineExceeded(
+                            "request deadline expired while queued for "
+                            "admission (never executed)"
+                        )
+                    self._cond.wait(timeout=remaining)
+            finally:
+                self.queued -= 1
+                try:
+                    self._waiters.remove(ticket)
+                except ValueError:  # pragma: no cover - defensive
+                    pass
+                # whatever removed us from the head (grant, shed,
+                # expiry), the next ticket must get a look
+                self._cond.notify_all()
+
+    def release(self) -> None:
+        with self._cond:
+            self.inflight -= 1
+            self._cond.notify_all()
+
+    def start_draining(self) -> None:
+        with self._cond:
+            self.draining = True
+            self._cond.notify_all()
+
+    def wait_idle(self, timeout_s: float) -> bool:
+        """Block until no gated request is in flight (True) or
+        ``timeout_s`` elapsed (False)."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._cond:
+            while self.inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
 
 
 class _Session:
-    """Per-connection state: the frame registry."""
+    """Server-side session state: the frame registry, the idempotency
+    dedup cache, and the per-method call counters fault injection keys
+    on.  Addressed by a ``hello`` token, so it survives its TCP
+    connection (reattach after a drop); no-``hello`` legacy connections
+    get an implicit session that dies with the connection."""
 
-    def __init__(self, engine=None):
+    def __init__(self, engine=None, token: str = "", max_frames: int = 0):
         self.engine = engine
         self.frames: Dict[int, TensorFrame] = {}
         self._next = 0
+        self.token = token
+        self.max_frames = int(max_frames)
+        self.lock = threading.Lock()
+        self.idem: "collections.OrderedDict[str, tuple]" = (
+            collections.OrderedDict()
+        )
+        self._idem_bytes = 0
+        # tokens whose FIRST execution is still running: a client whose
+        # read timed out mid-verb retries while the original handler
+        # thread is still executing — the retry must wait for that
+        # outcome, not start a concurrent second execution
+        self.idem_inflight: Dict[str, threading.Event] = {}
+        self.method_calls: Dict[str, int] = {}
+        self.explicit = False  # attached via hello (reattachable)
+        self.refs = 0  # connections currently attached
+        self.last_active = time.monotonic()
 
     def register(self, frame: TensorFrame) -> int:
-        self._next += 1
-        self.frames[self._next] = frame
-        return self._next
+        with self.lock:
+            if self.max_frames and len(self.frames) >= self.max_frames:
+                ids = sorted(self.frames)
+                shown = ", ".join(map(str, ids[:16]))
+                if len(ids) > 16:
+                    shown += f", ... ({len(ids) - 16} more)"
+                raise FrameCapExceeded(
+                    f"session holds {len(self.frames)} frames — the "
+                    f"{ENV_MAX_FRAMES}={self.max_frames} cap; release "
+                    f"leaked frame ids [{shown}] (a loop that never "
+                    f"calls release() grows the registry for the life "
+                    f"of the session)",
+                    leaked_frame_ids=ids[:64],
+                )
+            self._next += 1
+            self.frames[self._next] = frame
+            return self._next
 
     def frame(self, fid: int) -> TensorFrame:
         if fid not in self.frames:
             raise KeyError(f"unknown frame id {fid}")
         return self.frames[fid]
+
+    # -- idempotency dedup ---------------------------------------------------
+
+    def idem_lookup(self, token: str):
+        with self.lock:
+            entry = self.idem.get(token)
+            if entry is not None:
+                self.idem.move_to_end(token)
+            return entry
+
+    def idem_begin(self, token: str):
+        """-> ``("hit", entry)`` (outcome already recorded),
+        ``("wait", event)`` (first execution still running — wait for
+        its outcome instead of double-executing), or ``("own", None)``
+        (this request executes and must call :meth:`idem_finish`)."""
+        with self.lock:
+            entry = self.idem.get(token)
+            if entry is not None:
+                self.idem.move_to_end(token)
+                return "hit", entry
+            ev = self.idem_inflight.get(token)
+            if ev is not None:
+                return "wait", ev
+            ev = threading.Event()
+            self.idem_inflight[token] = ev
+            return "own", None
+
+    def idem_finish(self, token: str, entry) -> None:
+        """Record the owner's outcome (``entry`` may be None when the
+        request was refused before executing, e.g. shed) and wake any
+        retries waiting on it.  The cache is bounded by entry count AND
+        bytes; a single result past ``_IDEM_ENTRY_MAX_BYTES`` is
+        replaced with a replay-unavailable marker (the execution still
+        happened exactly once — only the replay is withheld)."""
+        if entry is not None:
+            kind, payload, bins = entry
+            nbytes = sum(len(b) for b in bins) + _approx_payload_bytes(
+                payload
+            )
+            if nbytes > _IDEM_ENTRY_MAX_BYTES:
+                entry = (
+                    "error",
+                    {
+                        "type": "IdemReplayUnavailable",
+                        "message": (
+                            "the original request executed exactly once, "
+                            "but its result was too large to retain for "
+                            "idempotent replay; re-issue as a NEW request"
+                        ),
+                        "code": "retry_conflict",
+                    },
+                    [],
+                )
+                nbytes = 512
+            entry = entry + (nbytes,)
+        with self.lock:
+            if entry is not None:
+                self.idem[token] = entry
+                self._idem_bytes += entry[3]
+                while self.idem and (
+                    len(self.idem) > _IDEM_CACHE_CAP
+                    or self._idem_bytes > _IDEM_CACHE_MAX_BYTES
+                ):
+                    _, old = self.idem.popitem(last=False)
+                    self._idem_bytes -= old[3]
+            ev = self.idem_inflight.pop(token, None)
+        if ev is not None:
+            ev.set()
+
+    def next_call_index(self, method: str) -> int:
+        with self.lock:
+            i = self.method_calls.get(method, 0)
+            self.method_calls[method] = i + 1
+            return i
 
     # -- methods (the RPC surface) ------------------------------------------
 
@@ -130,9 +511,103 @@ class _Session:
         return {"pong": True}
 
 
+def _approx_payload_bytes(v, _depth: int = 0) -> int:
+    """Cheap size estimate of an already-ENCODED (JSON-safe) payload for
+    the idem-cache byte bound: strings (inline base64 tensors included)
+    dominate real payload size, so summing their lengths approximates
+    the wire cost without paying a second full ``json.dumps`` on the
+    serving hot path."""
+    if isinstance(v, str):
+        return len(v)
+    if _depth < 16:
+        if isinstance(v, dict):
+            return sum(
+                len(k) + _approx_payload_bytes(x, _depth + 1)
+                for k, x in v.items()
+            )
+        if isinstance(v, (list, tuple)):
+            return sum(
+                _approx_payload_bytes(x, _depth + 1) for x in v
+            )
+    return 8
+
+
+def _error_payload(e: BaseException) -> Dict[str, Any]:
+    """Exception -> structured wire error (and the matching evidence
+    counter — bumped here, at payload CREATION, so a dedup-served cached
+    error never double-counts)."""
+    payload: Dict[str, Any] = {"type": type(e).__name__, "message": str(e)}
+    if isinstance(e, cancellation.DeadlineExceeded):
+        payload["code"] = "deadline_exceeded"
+        observability.note_bridge_deadline_exceeded()
+    elif isinstance(e, cancellation.Cancelled):
+        payload["code"] = "cancelled"
+        observability.note_bridge_cancel()
+    elif isinstance(e, BridgeServerError):
+        payload["code"] = e.code
+        for k, v in e.extra.items():
+            payload[k] = v
+    return payload
+
+
+def _sliced_sleep(
+    ms: float, scope: Optional[cancellation.CancelScope]
+) -> None:
+    """An injected stall that still cooperates with cancellation: sleep
+    in small slices, checking the scope between them."""
+    end = time.monotonic() + ms / 1000.0
+    while True:
+        if scope is not None:
+            scope.check()
+        remaining = end - time.monotonic()
+        if remaining <= 0:
+            return
+        time.sleep(min(0.01, remaining))
+
+
+class _DropReply(Exception):
+    """Internal: injected ``bridge_drop`` — sever the connection
+    instead of writing the (already computed and dedup-cached) reply."""
+
+
 class _Handler(socketserver.StreamRequestHandler):
+    def setup(self):
+        super().setup()
+        # keepalive: a client host that dies without FIN/RST (power
+        # loss, silent partition) would otherwise block this handler in
+        # readline forever with the session pinned at refs=1 — beyond
+        # the TTL reaper's reach.  OS keepalive eventually surfaces the
+        # dead peer as a read error, which detaches and frees it.
+        try:
+            self.connection.setsockopt(
+                socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1
+            )
+        except OSError:  # pragma: no cover - exotic socket types
+            pass
+        self._session: Optional[_Session] = None
+        self._err_logged = False
+
+    def finish(self):
+        if self._session is not None:
+            self.server._detach(self._session)  # type: ignore[attr-defined]
+            self._session = None
+        super().finish()
+
+    def _log_once(self, what: str, exc: BaseException) -> None:
+        """Once-per-connection error-path log: the old handler died
+        silently when the error reply itself failed (round-11 satellite
+        fix); repeated failures on one connection stay one line."""
+        if not self._err_logged:
+            self._err_logged = True
+            logger.warning(
+                "bridge connection %s: %s: %s: %s",
+                self.client_address,
+                what,
+                type(exc).__name__,
+                exc,
+            )
+
     def handle(self):
-        session = _Session(engine=self.server.engine)  # type: ignore[attr-defined]
         while True:
             try:
                 msg, rbins = read_message(self.rfile)
@@ -140,44 +615,253 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
             mid = msg.get("id")
             try:
-                method = msg["method"]
-                params = decode_value(msg.get("params") or {}, rbins)
-                if method in (
-                    "map_blocks",
-                    "map_rows",
-                    "aggregate",
-                ):
-                    result = session.run_df_verb(method, **params)
-                elif method in ("reduce_blocks", "reduce_rows"):
-                    result = session.run_row_verb(method, **params)
-                else:
-                    fn = getattr(session, method, None)
-                    if fn is None or method.startswith("_"):
-                        raise AttributeError(f"unknown method {method!r}")
-                    result = fn(**params)
-                bins: list = []
-                write_message(
-                    self.wfile,
-                    {"id": mid, "result": encode_value(result, bins)},
-                    bins,
-                )
-            except BrokenPipeError:
+                reply, bins = self._run_method(msg, rbins)
+            except _DropReply:
+                return  # injected dropped reply: sever without writing
+            except ConnectionError:
                 return
             except Exception as e:  # noqa: BLE001 — surfaced to the client
-                write_message(
-                    self.wfile,
-                    {
-                        "id": mid,
-                        "error": {
-                            "type": type(e).__name__,
-                            "message": str(e),
+                reply, bins = {"error": _error_payload(e)}, []
+            try:
+                write_message(self.wfile, dict(reply, id=mid), bins)
+            except ConnectionError:
+                # BrokenPipe AND reset-by-peer: an ordinary client
+                # disconnect mid-write (e.g. its read-timeout teardown),
+                # not a serialization failure — no fallback, no log spam
+                return
+            except Exception as we:  # noqa: BLE001 — degrade, don't die
+                # the reply write itself failed (result payload past a
+                # wire cap, serialization bug): fall back to a minimal
+                # error so the client is never left waiting on a
+                # silently dead loop
+                self._log_once("reply write failed", we)
+                try:
+                    write_message(
+                        self.wfile,
+                        {
+                            "id": mid,
+                            "error": {
+                                "type": type(we).__name__,
+                                "message": str(we),
+                            },
                         },
-                    },
+                    )
+                except Exception as we2:  # noqa: BLE001
+                    self._log_once(
+                        "minimal error reply failed; closing", we2
+                    )
+                    return
+
+    # -- per-request processing ---------------------------------------------
+
+    def _run_method(self, msg: dict, rbins: list):
+        """-> ``(reply_without_id, bins)``; raises ``_DropReply`` for an
+        injected dropped reply and structured exceptions for refusals."""
+        server = self.server  # type: ignore[attr-defined]
+        method = msg.get("method")
+        if not isinstance(method, str) or method.startswith("_"):
+            raise AttributeError(f"unknown method {method!r}")
+
+        # connection-scoped control plane (no session state touched)
+        if method == "hello":
+            params = decode_value(msg.get("params") or {}, rbins)
+            sess = server._attach(params.get("session"))
+            # ALWAYS balance the previous attach — a repeated hello with
+            # the same token would otherwise leak a ref (attach bumps
+            # refs every time; finish() only decrements once), pinning
+            # the session past its TTL forever
+            if self._session is not None:
+                server._detach(self._session)
+            self._session = sess
+            return {
+                "result": {"session": sess.token, "pv": PROTOCOL_VERSION}
+            }, []
+        if method == "health":
+            bins: list = []
+            return {
+                "result": encode_value(server.health_snapshot(), bins)
+            }, bins
+
+        sess = self._session
+        if sess is None:
+            # legacy no-hello path: an implicit session that dies with
+            # the connection (nothing to reattach to without a token)
+            sess = self._session = server._attach(None)
+            sess.explicit = False
+        if method == "end_session":
+            server._drop_session(sess)
+            # unbind: the next request on this connection re-attaches a
+            # fresh REGISTERED session instead of executing against a
+            # zombie the reaper and health can no longer see
+            self._session = None
+            return {"result": {}}, []
+
+        call_i = sess.next_call_index(method)
+        fplan = (
+            faults.maybe_inject_bridge(method, call_i)
+            if faults.bridge_active()
+            else None
+        )
+        gated = method in _GATED_METHODS
+        if not gated:
+            if method not in _UNGATED_METHODS:
+                raise AttributeError(f"unknown method {method!r}")
+            if fplan is not None and fplan.stall_ms:
+                # ungated methods have no cancel scope; the stall still
+                # applies (chaos on ping/schema/release exercises client
+                # timeouts), just uncancellable
+                _sliced_sleep(fplan.stall_ms, None)
+            params = decode_value(msg.get("params") or {}, rbins)
+            result = getattr(sess, method)(**params)
+            return self._finish_reply(
+                *self._encode_result(method, result), fplan
+            )
+
+        deadline_ms = msg.get("deadline_ms")
+        scope = cancellation.CancelScope(
+            deadline_s=(
+                float(deadline_ms) / 1000.0
+                if deadline_ms is not None
+                else None
+            ),
+            label=f"bridge:{method}",
+        )
+
+        # idempotency dedup BEFORE admission: a retried request whose
+        # first run already recorded an outcome is served that outcome
+        # without costing an admission slot; a retry racing its ORIGINAL
+        # (client read-timeout while the verb still runs) waits for the
+        # original's outcome instead of double-executing
+        idem = msg.get("idem")
+        owner = False
+        if isinstance(idem, str):
+            state, val = sess.idem_begin(idem)
+            if state == "hit":
+                observability.note_bridge_idem_hit()
+                kind, payload, bins = val[:3]
+                return self._finish_reply(
+                    {("result" if kind == "result" else "error"): payload},
+                    bins,
+                    fplan,
                 )
+            if state == "wait":
+                remaining = scope.time_remaining()
+                val.wait(
+                    _IDEM_WAIT_CAP_S
+                    if remaining is None
+                    else max(0.0, min(remaining, _IDEM_WAIT_CAP_S))
+                )
+                hit = sess.idem_lookup(idem)
+                if hit is not None:
+                    observability.note_bridge_idem_hit()
+                    kind, payload, bins = hit[:3]
+                    return self._finish_reply(
+                        {
+                            ("result" if kind == "result" else "error"):
+                            payload
+                        },
+                        bins,
+                        fplan,
+                    )
+                # an expired deadline while waiting is a deadline, not a
+                # conflict — clients branch on deadline_exceeded to stop
+                # retrying a dead request
+                scope.check()
+                raise BridgeServerError(
+                    f"idempotent retry of {method} raced its original "
+                    f"execution and no outcome was recorded within the "
+                    f"wait window; retry again later",
+                    code="retry_conflict",
+                )
+            owner = True
+        else:
+            idem = None
+
+        # gated: admission -> cancel scope -> execute -> encode; every
+        # outcome (success or error) is dedup-cached under the idem
+        # token, and waiters are woken even when admission refuses
+        entry = None
+        try:
+            server.gate.admit(scope)
+            server._register_scope(scope)
+            try:
+                with observability.verb_span(
+                    f"bridge:{method}", 0, 0
+                ) as span:
+                    span.annotate("admission", server.gate.snapshot())
+                    try:
+                        # decode AFTER admission: a shed request must not
+                        # pay the base64/ndarray materialization CPU the
+                        # gate exists to protect admitted requests from
+                        params = decode_value(
+                            msg.get("params") or {}, rbins
+                        )
+                        if fplan is not None and fplan.stall_ms:
+                            _sliced_sleep(fplan.stall_ms, scope)
+                        with cancellation.activate(scope):
+                            scope.check()  # deadline may have passed queued
+                            observability.note_bridge_verb_executed()
+                            if method in (
+                                "map_blocks",
+                                "map_rows",
+                                "aggregate",
+                            ):
+                                result = sess.run_df_verb(method, **params)
+                            elif method in ("reduce_blocks", "reduce_rows"):
+                                result = sess.run_row_verb(method, **params)
+                            else:  # create_frame / analyze / collect
+                                result = getattr(sess, method)(**params)
+                        reply, bins = self._encode_result(method, result)
+                        entry = ("result", reply["result"], bins)
+                    except Exception as e:  # noqa: BLE001 — structured
+                        span.annotate("failed", True)
+                        payload = _error_payload(e)
+                        reply, bins = {"error": payload}, []
+                        entry = ("error", payload, [])
+            finally:
+                server._unregister_scope(scope)
+                server.gate.release()
+        finally:
+            if owner:
+                sess.idem_finish(idem, entry)
+        return self._finish_reply(reply, bins, fplan)
+
+    def _encode_result(self, method: str, result):
+        """Encode a successful result, preserving execution context when
+        serialization itself fails (round-11 satellite: the old path
+        surfaced a bare encoding error as if the verb had failed)."""
+        bins: list = []
+        try:
+            return {"result": encode_value(result, bins)}, bins
+        except Exception as enc_exc:  # noqa: BLE001
+            self._log_once("result serialization failed", enc_exc)
+            raise ResultEncodingError(
+                f"{method} executed, but its result could not be "
+                f"serialized: {type(enc_exc).__name__}: {enc_exc}"
+            ) from enc_exc
+
+    def _finish_reply(self, reply, bins, fplan):
+        """Apply injected reply-path chaos: delay, then drop.  The drop
+        counts in ``faults_injected`` HERE — at the point the
+        connection is actually severed — so a request refused before
+        its reply (shed, draining) never reads as a fired fault."""
+        if fplan is not None:
+            if fplan.delay_ms:
+                time.sleep(fplan.delay_ms / 1000.0)
+            if fplan.drop:
+                observability.note_fault_injected()
+                logger.warning(
+                    "bridge: injected dropped reply (bridge_drop); "
+                    "severing %s",
+                    self.client_address,
+                )
+                raise _DropReply()
+        return reply, bins
 
 
 class BridgeServer(socketserver.ThreadingTCPServer):
-    """Localhost TCP bridge server; one session per connection.
+    """Localhost TCP bridge server; sessions are token-addressed and
+    survive their connections (``hello`` reattaches).
 
     The protocol executes client-supplied programs and is UNauthenticated —
     it is a local IPC seam (the analog of the reference's in-process Py4J
@@ -194,6 +878,11 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         port: int = 0,
         engine=None,
         allow_remote: bool = False,
+        max_inflight: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+        drain_s: Optional[float] = None,
+        max_frames: Optional[int] = None,
+        session_ttl_s: Optional[float] = None,
     ):
         if not allow_remote and host not in ("127.0.0.1", "::1", "localhost"):
             raise ValueError(
@@ -202,13 +891,193 @@ class BridgeServer(socketserver.ThreadingTCPServer):
             )
         super().__init__((host, port), _Handler)
         self.engine = engine
+        self.gate = AdmissionGate(
+            _env_int(ENV_MAX_INFLIGHT, DEFAULT_MAX_INFLIGHT)
+            if max_inflight is None
+            else max_inflight,
+            _env_int(ENV_QUEUE_DEPTH, DEFAULT_QUEUE_DEPTH)
+            if queue_depth is None
+            else queue_depth,
+        )
+        self.drain_s = (
+            _env_float(ENV_DRAIN_S, DEFAULT_DRAIN_S)
+            if drain_s is None
+            else float(drain_s)
+        )
+        self.max_frames = (
+            _env_int(ENV_MAX_FRAMES, DEFAULT_MAX_FRAMES)
+            if max_frames is None
+            else int(max_frames)
+        )
+        self.session_ttl_s = (
+            _env_float(ENV_SESSION_TTL_S, DEFAULT_SESSION_TTL_S)
+            if session_ttl_s is None
+            else float(session_ttl_s)
+        )
+        self._sessions: Dict[str, _Session] = {}
+        self._sessions_lock = threading.Lock()
+        self._scopes: set = set()
+        self._scopes_lock = threading.Lock()
+        self._closed = False
+        # periodic reaper: attach/detach/health also reap
+        # opportunistically, but only a timer guarantees an idle host
+        # (no further connections, no health polls) releases a crashed
+        # client's frames once their session passes the TTL
+        self._reaper_stop = threading.Event()
+        if self.session_ttl_s > 0:
+            t = threading.Thread(
+                target=self._reap_loop, name="tfs-bridge-reaper", daemon=True
+            )
+            t.start()
 
     @property
     def address(self):
         return self.server_address
 
-    def close(self) -> None:
-        """Stop serving and release the socket (shutdown + server_close)."""
+    # -- session registry ----------------------------------------------------
+
+    def _attach(self, token: Optional[str]) -> _Session:
+        now = time.monotonic()
+        with self._sessions_lock:
+            self._reap_locked(now)
+            if token is not None:
+                sess = self._sessions.get(token)
+                if sess is None:
+                    raise BridgeServerError(
+                        f"unknown or expired session {token!r} (frames do "
+                        f"not survive a session's TTL; create a new one)",
+                        code="unknown_session",
+                    )
+                sess.refs += 1
+                sess.last_active = now
+                return sess
+            tok = uuid.uuid4().hex
+            sess = _Session(
+                engine=self.engine, token=tok, max_frames=self.max_frames
+            )
+            sess.explicit = True
+            sess.refs = 1
+            self._sessions[tok] = sess
+            return sess
+
+    def _detach(self, sess: _Session) -> None:
+        now = time.monotonic()
+        with self._sessions_lock:
+            sess.refs -= 1
+            sess.last_active = now
+            if sess.refs <= 0 and not sess.explicit:
+                self._sessions.pop(sess.token, None)
+            # reap on every disconnect too (not just new attaches), so a
+            # host whose clients all left does not retain their frames
+            # past the TTL waiting for a connection that never comes
+            self._reap_locked(now)
+
+    def _drop_session(self, sess: _Session) -> None:
+        with self._sessions_lock:
+            self._sessions.pop(sess.token, None)
+            sess.frames.clear()
+
+    def _reap_loop(self) -> None:
+        interval = max(1.0, min(self.session_ttl_s / 2.0, 60.0))
+        while not self._reaper_stop.wait(interval):
+            with self._sessions_lock:
+                self._reap_locked(time.monotonic())
+
+    def _reap_locked(self, now: float) -> None:
+        if self.session_ttl_s <= 0:
+            return
+        dead = [
+            tok
+            for tok, s in self._sessions.items()
+            if s.refs <= 0 and now - s.last_active > self.session_ttl_s
+        ]
+        for tok in dead:
+            s = self._sessions.pop(tok)
+            logger.info(
+                "bridge: reaped idle session %s (%d frames)",
+                tok[:8],
+                len(s.frames),
+            )
+
+    # -- in-flight scope registry (drain cancellation) -----------------------
+
+    def _register_scope(self, scope: cancellation.CancelScope) -> None:
+        with self._scopes_lock:
+            self._scopes.add(scope)
+
+    def _unregister_scope(self, scope: cancellation.CancelScope) -> None:
+        with self._scopes_lock:
+            self._scopes.discard(scope)
+
+    # -- health --------------------------------------------------------------
+
+    def health_snapshot(self) -> Dict[str, Any]:
+        """The ``health`` RPC body: admission depth, drain state,
+        session/frame counts, device-quarantine history (PR 4), and HBM
+        budget occupancy (PR 5) — enough for a client-side balancer to
+        route around a sick or saturated server."""
+        gate = self.gate.snapshot()
+        with self._sessions_lock:
+            # health polls double as the idle-host reaper tick
+            self._reap_locked(time.monotonic())
+            n_sessions = len(self._sessions)
+            n_frames = sum(len(s.frames) for s in self._sessions.values())
+        c = observability.counters()
+        return {
+            "status": "draining" if gate["draining"] else "ok",
+            **gate,
+            "sessions": n_sessions,
+            "frames": n_frames,
+            "quarantined_devices": device_pool.recently_quarantined(),
+            "hbm": {
+                "budget_bytes": frame_cache.hbm_budget(),
+                "resident_bytes": frame_cache.budget_bytes_resident(),
+            },
+            "counters": {
+                k: c[k]
+                for k in (
+                    "bridge_deadline_exceeded",
+                    "bridge_shed",
+                    "bridge_cancels",
+                    "bridge_idem_hits",
+                    "bridge_verbs_executed",
+                    "devices_quarantined",
+                )
+            },
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, drain_s: Optional[float] = None) -> None:
+        """Graceful drain, then stop serving and release the socket.
+
+        Phases: (1) reject new admissions with ``draining``; (2) wait up
+        to ``drain_s`` (default ``TFS_BRIDGE_DRAIN_S``) for in-flight
+        gated requests to finish; (3) cooperatively cancel stragglers
+        through their cancel scopes (they surface a structured
+        ``cancelled`` error at their next block boundary) and give them
+        a short grace period; (4) shutdown + server_close."""
+        if self._closed:
+            return
+        self._closed = True
+        self._reaper_stop.set()
+        budget = self.drain_s if drain_s is None else float(drain_s)
+        self.gate.start_draining()
+        if not self.gate.wait_idle(budget):
+            with self._scopes_lock:
+                stragglers = list(self._scopes)
+            logger.warning(
+                "bridge: drain window (%.1fs) expired with %d request(s) "
+                "in flight; cancelling cooperatively",
+                budget,
+                len(stragglers),
+            )
+            for scope in stragglers:
+                scope.cancel("server draining")
+            # short FIXED grace: cancellation lands at the next block
+            # boundary, which does not scale with the drain budget —
+            # close() is bounded by budget + 1s, not 2x budget
+            self.gate.wait_idle(1.0)
         self.shutdown()
         self.server_close()
 
@@ -219,11 +1088,16 @@ def serve(
     engine=None,
     background: bool = True,
     allow_remote: bool = False,
+    **server_kw,
 ) -> BridgeServer:
     """Start a bridge server; ``background=True`` runs it on a daemon
     thread and returns immediately (``server.address`` has the bound
-    port)."""
-    server = BridgeServer(host, port, engine=engine, allow_remote=allow_remote)
+    port).  ``server_kw`` forwards the resilience knobs
+    (``max_inflight``, ``queue_depth``, ``drain_s``, ``max_frames``,
+    ``session_ttl_s``) past their env defaults."""
+    server = BridgeServer(
+        host, port, engine=engine, allow_remote=allow_remote, **server_kw
+    )
     if background:
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()

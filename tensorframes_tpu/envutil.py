@@ -1,0 +1,46 @@
+"""Shared env-knob parsing: one definition of the clamp-and-fallback
+semantics every ``TFS_*`` knob uses (malformed values fall back to the
+default; numeric values clamp to the floor).  Round 11 hoisted this out
+of the bridge modules, which were growing their third and fourth copies
+of the same try/int/ValueError pattern."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def env_int(name: str, default: int, floor: int = 0) -> int:
+    """``int(os.environ[name])`` clamped to ``floor``; ``default`` when
+    unset or malformed."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return max(floor, int(raw))
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float, floor: float = 0.0) -> float:
+    """``float(os.environ[name])`` clamped to ``floor``; ``default``
+    when unset or malformed."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return max(floor, float(raw))
+    except ValueError:
+        return default
+
+
+def env_opt_float(name: str) -> Optional[float]:
+    """``float(os.environ[name])`` clamped to 0, or None when unset,
+    empty, or malformed (for knobs whose absence means 'no limit')."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return None

@@ -26,6 +26,23 @@ Kinds:
 * ``delay`` — sleep ``ms`` milliseconds at the dispatch boundary
   (staging-skew chaos without failing anything).
 
+Bridge kinds (round 11, consumed by ``bridge/server.py`` via
+:func:`maybe_inject_bridge`, selectors ``method=NAME``/``call=N`` plus
+``rate``/``seed``): ``bridge_stall:ms=`` (sleep inside the request's
+cancel scope before execution — a wedged verb), ``bridge_delay:ms=``
+(sleep before writing the reply — a slow link), ``bridge_drop``
+(execute, then sever the connection without replying — the dropped-reply
+case the idempotent client retry exists for)::
+
+    TFS_FAULT_INJECT="bridge_drop:method=map_blocks:call=0"
+
+Bridge injection targets SESSION-BOUND RPC methods (the gated verbs plus
+ping/schema/release); the connection control plane — ``hello``,
+``health``, ``end_session`` — dispatches before the injection hook and
+cannot be targeted (``method=hello`` parses but never fires: those paths
+must stay reliable so chaos tests can still attach, observe, and clean
+up around the faults they inject).
+
 Selectors (all optional; a spec fires when every given selector
 matches):
 
@@ -66,9 +83,21 @@ logger = logging.getLogger("tensorframes_tpu.faults")
 
 ENV_VAR = "TFS_FAULT_INJECT"
 
-_KINDS = ("transient", "oom", "delay")
-_INT_KEYS = ("block", "device", "attempt", "minrows", "seed")
+# engine kinds fire at the block-dispatch boundary; bridge kinds fire in
+# the bridge server's request path (round 11): ``bridge_stall`` sleeps
+# INSIDE the verb's cancel scope before execution (a wedged program —
+# the sleep is sliced so cooperative deadlines still fire),
+# ``bridge_delay`` sleeps after execution before the reply is written
+# (a slow link), ``bridge_drop`` executes the request then severs the
+# connection without replying (the dropped-reply case idempotent retry
+# exists for).  Selectors ``method=NAME`` and ``call=N`` (the N-th
+# invocation of that method in the session, 0-based) target them.
+_ENGINE_KINDS = ("transient", "oom", "delay")
+_BRIDGE_KINDS = ("bridge_stall", "bridge_delay", "bridge_drop")
+_KINDS = _ENGINE_KINDS + _BRIDGE_KINDS
+_INT_KEYS = ("block", "device", "attempt", "minrows", "seed", "call")
 _FLOAT_KEYS = ("rate", "ms")
+_STR_KEYS = ("method",)
 
 
 class InjectedTransient(RuntimeError):
@@ -90,6 +119,8 @@ class FaultSpec:
     seed: int = 0
     ms: float = 0.0
     index: int = 0  # position in the spec list (decorrelates rate draws)
+    method: Optional[str] = None  # bridge kinds: RPC method selector
+    call: Optional[int] = None  # bridge kinds: per-session call index
 
     def matches(
         self,
@@ -115,6 +146,23 @@ class FaultSpec:
         if self.rate is not None:
             draw = random.Random(
                 f"{self.seed}:{self.index}:{self.kind}:{block}:{attempt}"
+            ).random()
+            if draw >= self.rate:
+                return False
+        return True
+
+    def matches_bridge(self, method: str, call: int) -> bool:
+        """Whether this (bridge-kind) spec fires for the ``call``-th
+        invocation of ``method`` in a bridge session.  Rate draws hash
+        from ``(seed, index, kind, method, call)`` — the same counter-
+        free determinism the dispatch-boundary draws use."""
+        if self.method is not None and self.method != method:
+            return False
+        if self.call is not None and self.call != call:
+            return False
+        if self.rate is not None:
+            draw = random.Random(
+                f"{self.seed}:{self.index}:{self.kind}:{method}:{call}"
             ).random()
             if draw >= self.rate:
                 return False
@@ -157,11 +205,38 @@ def _parse_one(raw: str, index: int) -> Optional[FaultSpec]:
                 fields[key] = int(val)
             elif key in _FLOAT_KEYS:
                 fields[key] = float(val)
+            elif key in _STR_KEYS:
+                fields[key] = val.strip()
             else:
                 _warn_once(raw, f"unknown selector {key!r}")
                 return None
         except ValueError:
             _warn_once(raw, f"selector {key}={val!r} is not numeric")
+            return None
+    # selectors are kind-scoped: an engine-kind spec with method=/call=
+    # (or a bridge-kind spec with block=/device=/attempt=/minrows=)
+    # would PARSE but never be consulted by the matching side — firing
+    # unscoped process-wide instead of where the selector pointed.
+    # Warn-and-drop, like every other malformed spec.
+    _BRIDGE_ONLY = ("method", "call")
+    _ENGINE_ONLY = ("block", "device", "attempt", "minrows")
+    if kind in _ENGINE_KINDS:
+        bad = [k for k in _BRIDGE_ONLY if k in fields]
+        if bad:
+            _warn_once(
+                raw,
+                f"selector(s) {bad} only apply to bridge kinds "
+                f"{'/'.join(_BRIDGE_KINDS)}",
+            )
+            return None
+    else:
+        bad = [k for k in _ENGINE_ONLY if k in fields]
+        if bad:
+            _warn_once(
+                raw,
+                f"selector(s) {bad} only apply to engine kinds "
+                f"{'/'.join(_ENGINE_KINDS)}",
+            )
             return None
     return FaultSpec(**fields)
 
@@ -187,8 +262,16 @@ def specs() -> List[FaultSpec]:
 
 
 def active() -> bool:
-    """Whether any injection spec is live."""
-    return bool(specs())
+    """Whether any ENGINE-level injection spec is live (gates the
+    dispatch-boundary fault layer; bridge-only specs must not flip the
+    engine onto its retry-session path — that would perturb the trace
+    fences of a request that only wanted bridge chaos)."""
+    return any(s.kind in _ENGINE_KINDS for s in specs())
+
+
+def bridge_active() -> bool:
+    """Whether any bridge-level injection spec is live."""
+    return any(s.kind in _BRIDGE_KINDS for s in specs())
 
 
 def maybe_inject(
@@ -205,6 +288,8 @@ def maybe_inject(
     if not plan:
         return
     for spec in plan:
+        if spec.kind not in _ENGINE_KINDS:
+            continue  # bridge kinds fire in the bridge server, not here
         if not spec.matches(block, attempt, device, n_rows, site):
             continue
         if spec.kind == "delay":
@@ -222,6 +307,52 @@ def maybe_inject(
         raise InjectedOOM(
             f"RESOURCE_EXHAUSTED: injected out-of-memory ({where})"
         )
+
+
+class BridgeFaultPlan:
+    """The aggregated bridge-injection actions for one request:
+    ``stall_ms`` (sleep before execution, inside the request's cancel
+    scope), ``delay_ms`` (sleep after execution, before the reply), and
+    ``drop`` (sever the connection instead of replying)."""
+
+    __slots__ = ("stall_ms", "delay_ms", "drop")
+
+    def __init__(self):
+        self.stall_ms = 0.0
+        self.delay_ms = 0.0
+        self.drop = False
+
+    def __bool__(self) -> bool:
+        return bool(self.stall_ms or self.delay_ms or self.drop)
+
+
+def maybe_inject_bridge(method: str, call: int) -> Optional[BridgeFaultPlan]:
+    """The bridge server's injection hook: the combined
+    :class:`BridgeFaultPlan` for the ``call``-th invocation of
+    ``method`` in this session, or None (one truthiness check when
+    ``TFS_FAULT_INJECT`` is unset).  A ``bridge_drop`` that actually
+    severs a connection counts in ``faults_injected`` — the SERVER
+    bumps the counter at the drop site, not here, because a request
+    refused before its reply (shed, draining) never reaches the drop
+    and an uncounted plan must not read as a fired fault.  Stalls and
+    delays are adversity, not failures, and stay uncounted like the
+    dispatch-boundary ``delay`` kind."""
+    plan = specs()
+    if not plan:
+        return None
+    out = BridgeFaultPlan()
+    for spec in plan:
+        if spec.kind not in _BRIDGE_KINDS:
+            continue
+        if not spec.matches_bridge(method, call):
+            continue
+        if spec.kind == "bridge_stall":
+            out.stall_ms += spec.ms
+        elif spec.kind == "bridge_delay":
+            out.delay_ms += spec.ms
+        else:
+            out.drop = True
+    return out if out else None
 
 
 _OOM_MARKERS = ("resource_exhausted", "resource exhausted", "out of memory")

@@ -26,7 +26,9 @@ The reference's observability is a Logging trait + log4j config + pervasive
   record — compile counts are *proven*, not asserted.
 
 Deliberately cheap: a disabled span is one ``if``; a counter bump is one
-dict increment.
+dict increment under an uncontended lock (bridge handler threads bump
+concurrently since round 11; the paths are at most per-block, never
+per-element).
 """
 
 from __future__ import annotations
@@ -34,6 +36,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import logging
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -74,8 +77,28 @@ _counters: Dict[str, int] = {
     "h2d_bytes_staged": 0,
     "cache_shard_hits": 0,
     "cache_evictions": 0,
+    # bridge serving resilience (round 11): deadline/shed/cancel/retry
+    # evidence for the admission-controlled request path
+    "bridge_deadline_exceeded": 0,
+    "bridge_shed": 0,
+    "bridge_retries": 0,
+    "bridge_cancels": 0,
+    "bridge_idem_hits": 0,
+    "bridge_verbs_executed": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
+
+# counters were single-thread-bumped until round 11; the bridge's
+# ThreadingTCPServer handlers now increment them concurrently, and an
+# unlocked ``+= 1`` interleaves and loses counts under exactly the load
+# the bridge counters exist to measure.  One uncontended lock per bump
+# is ~100ns on a path that is at most per-block, never per-element.
+_counters_lock = threading.Lock()
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _counters_lock:
+        _counters[key] += n
 
 # the verb currently executing on this thread (set by verb_span even when
 # spans are disabled, so counter attribution never depends on enable())
@@ -94,9 +117,10 @@ _listeners_installed = False
 def _verb_bump(kind: str) -> None:
     verb = _current_verb.get()
     if verb is not None:
-        _by_verb.setdefault(
-            verb, {"program_traces": 0, "backend_compiles": 0}
-        )[kind] += 1
+        with _counters_lock:
+            _by_verb.setdefault(
+                verb, {"program_traces": 0, "backend_compiles": 0}
+            )[kind] += 1
 
 
 def note_program_trace() -> None:
@@ -105,7 +129,7 @@ def note_program_trace() -> None:
     miss, so in steady state this counter does not move)."""
     if _suppress_traces.get():
         return
-    _counters["program_traces"] += 1
+    _bump("program_traces")
     _verb_bump("program_traces")
 
 
@@ -113,35 +137,35 @@ def note_pool_dispatch() -> None:
     """Called by the device-pool scheduler (``ops/device_pool.py``) once
     per block dispatched through the pool — the always-on counter that
     lets a bench record prove pool utilisation rather than assert it."""
-    _counters["pool_blocks"] += 1
+    _bump("pool_blocks")
 
 
 def note_block_retry() -> None:
     """One transient block-dispatch failure absorbed by the per-block
     retry loop (``ops/fault_tolerance.py``)."""
-    _counters["block_retries"] += 1
+    _bump("block_retries")
 
 
 def note_oom_split() -> None:
     """One OOM-degradation binary split performed on a map-verb block."""
-    _counters["block_oom_splits"] += 1
+    _bump("block_oom_splits")
 
 
 def note_device_quarantined() -> None:
     """One pool device drained after repeated transient failures."""
-    _counters["devices_quarantined"] += 1
+    _bump("devices_quarantined")
 
 
 def note_fault_injected() -> None:
     """One fault raised by the ``TFS_FAULT_INJECT`` harness
     (``faults.py``) — chaos evidence for tests and the bench."""
-    _counters["faults_injected"] += 1
+    _bump("faults_injected")
 
 
 def note_pool_copy_fallback() -> None:
     """One ``copy_to_host_async`` failure in the pool readback window
     that fell back to synchronous readback (``PoolRun.submit``)."""
-    _counters["pool_copy_fallbacks"] += 1
+    _bump("pool_copy_fallbacks")
 
 
 def note_h2d_bytes(n: int) -> None:
@@ -150,19 +174,55 @@ def note_h2d_bytes(n: int) -> None:
     pipeline entry staging).  The evidence counter behind the sharded
     frame cache: an epoch served entirely from HBM shards leaves this
     at zero."""
-    _counters["h2d_bytes_staged"] += int(n)
+    _bump("h2d_bytes_staged", int(n))
 
 
 def note_cache_shard_hit() -> None:
     """One block dispatch served from a resident frame-cache shard
     (``ops/frame_cache.py``) instead of host staging."""
-    _counters["cache_shard_hits"] += 1
+    _bump("cache_shard_hits")
 
 
 def note_cache_eviction() -> None:
     """One cached shard evicted back to its authoritative host copy by
     the ``TFS_HBM_BUDGET`` LRU."""
-    _counters["cache_evictions"] += 1
+    _bump("cache_evictions")
+
+
+def note_bridge_deadline_exceeded() -> None:
+    """One bridge request cancelled at a block boundary because its
+    ``deadline_ms`` passed (``bridge/server.py``)."""
+    _bump("bridge_deadline_exceeded")
+
+
+def note_bridge_shed() -> None:
+    """One bridge request shed by admission control (``ServerBusy`` /
+    ``Draining``) instead of queueing unboundedly."""
+    _bump("bridge_shed")
+
+
+def note_bridge_retry() -> None:
+    """One client-side bridge call retried after a reconnect (safe
+    methods and idempotency-tokened verb calls only)."""
+    _bump("bridge_retries")
+
+
+def note_bridge_cancel() -> None:
+    """One in-flight bridge request cooperatively cancelled (graceful
+    drain's straggler cancellation)."""
+    _bump("bridge_cancels")
+
+
+def note_bridge_idem_hit() -> None:
+    """One bridge request served from the idempotency-token dedup cache
+    instead of re-executing — the exactly-once evidence counter."""
+    _bump("bridge_idem_hits")
+
+
+def note_bridge_verb_executed() -> None:
+    """One admission-gated bridge method actually executed (dedup hits
+    and shed requests never bump this)."""
+    _bump("bridge_verbs_executed")
 
 
 @contextlib.contextmanager
@@ -178,14 +238,14 @@ def suppress_trace_count():
 
 def _on_event(name: str, **kw) -> None:
     if name == _CACHE_HIT_EVENT:
-        _counters["persistent_cache_hits"] += 1
+        _bump("persistent_cache_hits")
     elif name == _CACHE_MISS_EVENT:
-        _counters["persistent_cache_misses"] += 1
+        _bump("persistent_cache_misses")
 
 
 def _on_event_duration(name: str, duration: float, **kw) -> None:
     if name == _BACKEND_COMPILE_EVENT:
-        _counters["backend_compiles"] += 1
+        _bump("backend_compiles")
         _verb_bump("backend_compiles")
 
 
@@ -216,8 +276,9 @@ def counters() -> Dict[str, Any]:
     program compiles; ``by_verb`` attributes both to the verb that was
     running.  Diff two snapshots (:func:`counters_delta`) to meter one
     region."""
-    snap: Dict[str, Any] = dict(_counters)
-    snap["by_verb"] = {k: dict(v) for k, v in _by_verb.items()}
+    with _counters_lock:
+        snap: Dict[str, Any] = dict(_counters)
+        snap["by_verb"] = {k: dict(v) for k, v in _by_verb.items()}
     return snap
 
 
@@ -243,6 +304,12 @@ def counters_delta(
             "h2d_bytes_staged",
             "cache_shard_hits",
             "cache_evictions",
+            "bridge_deadline_exceeded",
+            "bridge_shed",
+            "bridge_retries",
+            "bridge_cancels",
+            "bridge_idem_hits",
+            "bridge_verbs_executed",
         )
     }
 

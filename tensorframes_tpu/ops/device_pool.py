@@ -63,6 +63,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -131,6 +132,30 @@ def pool_devices() -> List[Any]:
 def enabled() -> bool:
     """Whether the pool would engage (>= 2 resolved devices)."""
     return len(pool_devices()) >= 2
+
+
+# process-wide quarantine memory (round 11): quarantine decisions live on
+# each PoolRun, but a serving front-end needs to report "this host has a
+# sick chip" across requests — every quarantine event also lands here so
+# the bridge's health RPC can expose it.  Advisory/observational only:
+# scheduling always consults the CURRENT run's own failure counts.
+_quarantine_history: set = set()
+_quarantine_lock = threading.Lock()
+
+
+def recently_quarantined() -> List[int]:
+    """Device indices any PoolRun quarantined since process start (or
+    the last :func:`reset_quarantine_history`) — the health-RPC view of
+    chip sickness on this host."""
+    with _quarantine_lock:
+        return sorted(_quarantine_history)
+
+
+def reset_quarantine_history() -> None:
+    """Clear the advisory quarantine history (tests; an operator's
+    "I swapped the chip" acknowledgement)."""
+    with _quarantine_lock:
+        _quarantine_history.clear()
 
 
 def assign(block_sizes: Sequence[int], n_devices: int) -> List[int]:
@@ -242,6 +267,8 @@ class PoolRun:
         if self.failures[di] < self._quarantine_after:
             return False
         self.quarantined.add(di)
+        with _quarantine_lock:
+            _quarantine_history.add(di)
         observability.note_device_quarantined()
         healthy = len(self.devices) - len(self.quarantined)
         logger.warning(

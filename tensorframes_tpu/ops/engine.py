@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import dtypes, faults, observability
+from .. import cancellation, dtypes, faults, observability
 from ..frame import Column, TensorFrame
 from ..program import Program
 from ..schema import ColumnInfo, Schema
@@ -412,7 +412,14 @@ class Executor:
         )
         pf = prefetch.Prefetcher(stage, len(starts))
         if session is None:
-            outs: List[Dict[str, Any]] = [run(inputs) for inputs in pf]
+            # chunk boundary = cancellation checkpoint (the streamed
+            # analog of the block-boundary check); a no-op contextvar
+            # read without an active scope
+            outs: List[Dict[str, Any]] = []
+            for inputs in pf:
+                cancellation.checkpoint()
+                outs.append(run(inputs))
+                del inputs
         else:
             # chunk-granular retry: each chunk dispatch is its own
             # attempt unit (fault injection keys on the BLOCK index, so
@@ -712,6 +719,10 @@ class Executor:
             None for _ in range(frame.num_blocks)
         )
         for bi, staged in enumerate(items):
+            # cooperative cancellation (bridge deadlines / drain): the
+            # block boundary is the check granularity — one contextvar
+            # read when no scope is active
+            cancellation.checkpoint()
             n_rows = block_sizes[bi]
             if plans[bi] is not None:
                 outs = self._run_block_streamed(
@@ -1137,6 +1148,7 @@ class Executor:
         out_blocks: List[Optional[Dict[str, Any]]] = [None] * nb
         lane_dead = [False] * (1 if single_iter is not None else len(devices))
         for bi in range(nb):
+            cancellation.checkpoint()  # block boundary (pooled loop)
             di = assignment[bi]
             li = 0 if single_iter is not None else di
             it = single_iter if single_iter is not None else lane_iters[di]
@@ -1271,6 +1283,7 @@ class Executor:
         hits = 0
         restaged = 0
         for bi in range(nb):
+            cancellation.checkpoint()  # block boundary (sharded loop)
             di = cache.assignment[bi]
             di_eff = pool.effective_device(di) if session is not None else di
             shard = cache.shard(bi)
@@ -1983,6 +1996,10 @@ class Executor:
         sizes = frame.block_sizes
         nonempty = [bi for bi in range(frame.num_blocks) if sizes[bi] > 0]
         sts = {b: dtypes.coerce(reduced[b].scalar_type) for b in bases}
+        # base -> RESOLVED source column (feed-dict renames, round 11):
+        # check_reduce_* returns the fed column's ColumnInfo, so its
+        # .name is what block dicts and cache shards key on
+        cols = {b: reduced[b].name for b in bases}
         session = fault_tolerance.frame_session(
             frame.num_blocks, verb="reduce"
         )
@@ -1994,7 +2011,7 @@ class Executor:
         cache = frame_cache.active_cache(frame)
         if cache is not None and len(nonempty) > 1:
             return self._reduce_partials_sharded(
-                run, bases, sts, frame, span, cache, session, sizes,
+                run, bases, sts, cols, frame, span, cache, session, sizes,
                 nonempty,
             )
         pool_devs = (
@@ -2009,11 +2026,12 @@ class Executor:
         if len(pool_devs) < 2:
             partials: List[Dict[str, jnp.ndarray]] = []
             for bi in nonempty:
+                cancellation.checkpoint()  # block boundary (partials)
 
                 def attempt(a, dev_i, _bi=bi):
                     block = frame.block(_bi)
                     arrays = {
-                        b: self._device_value(block[b], sts[b])
+                        b: self._device_value(block[cols[b]], sts[b])
                         for b in bases
                     }
                     return run(arrays)
@@ -2042,7 +2060,7 @@ class Executor:
         def stage_block(k, dev):
             block = frame.block(nonempty[k])
             return {
-                b: self._device_value(block[b], sts[b], device=dev)
+                b: self._device_value(block[cols[b]], sts[b], device=dev)
                 for b in bases
             }
 
@@ -2052,6 +2070,7 @@ class Executor:
         combine = pool_devs[0]
         partials = []
         for k, bi in enumerate(nonempty):
+            cancellation.checkpoint()  # block boundary (pooled partials)
             di = assignment[k]
             if session is None:
                 arrays = next(lane_iters[di])
@@ -2100,7 +2119,8 @@ class Executor:
         return partials
 
     def _reduce_partials_sharded(
-        self, run, bases, sts, frame, span, cache, session, sizes, nonempty
+        self, run, bases, sts, cols, frame, span, cache, session, sizes,
+        nonempty,
     ) -> List[Dict[str, jnp.ndarray]]:
         """Affinity partials for the reduce verbs over a sharded-cached
         frame: each nonempty block's fold runs on its resident device
@@ -2121,9 +2141,12 @@ class Executor:
         partials: List[Dict[str, jnp.ndarray]] = []
         hits = 0
         for bi in nonempty:
+            cancellation.checkpoint()  # block boundary (sharded partials)
             di = cache.assignment[bi]
             shard0 = cache.shard(bi)
-            has_shard = shard0 is not None and any(b in shard0 for b in bases)
+            has_shard = shard0 is not None and any(
+                cols[b] in shard0 for b in bases
+            )
             # whether the attempt that SUCCEEDED read the shard — a
             # retried block re-stages from host, and the hit counter
             # must not claim otherwise
@@ -2134,9 +2157,9 @@ class Executor:
                 shard = _shard if use_shard else None
                 return {
                     b: self._device_value(
-                        shard[b]
-                        if shard is not None and b in shard
-                        else block[b],
+                        shard[cols[b]]
+                        if shard is not None and cols[b] in shard
+                        else block[cols[b]],
                         sts[b],
                         device=devices[dev_i],
                     )
@@ -2372,7 +2395,7 @@ class Executor:
         for b in bases:
             ci = reduced[b]
             st = dtypes.coerce(ci.scalar_type)
-            data[b] = np.asarray(frame.column(b).data).astype(
+            data[b] = np.asarray(frame.column(ci.name).data).astype(
                 st.np_dtype, copy=False
             )[order]
 
@@ -2486,7 +2509,7 @@ class Executor:
                 return None
             kcols.append(kcol)
         for b in bases:
-            col = frame.column(b)
+            col = frame.column(reduced[b].name)
             if col.is_ragged or not col.info.scalar_type.device_ok:
                 return None
         plan = _recognize_segment_plan(program, reduced, bases)
@@ -2536,7 +2559,9 @@ class Executor:
         in_cols = {}
         for b in bases:
             st = dtypes.coerce(reduced[b].scalar_type)
-            arr = jnp.asarray(frame.column(b).data).astype(st.np_dtype)
+            arr = jnp.asarray(frame.column(reduced[b].name).data).astype(
+                st.np_dtype
+            )
             if pad_rows:
                 ident = _monoid_identity(
                     plan.trivial_kinds[b], st.np_dtype

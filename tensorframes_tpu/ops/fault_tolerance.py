@@ -56,11 +56,11 @@ Knobs:
 from __future__ import annotations
 
 import logging
-import os
 import time
 from typing import Any, Callable, Optional, Tuple
 
-from .. import faults, observability, resilience
+from .. import cancellation, faults, observability, resilience
+from ..envutil import env_float as _env_float, env_int as _env_int
 
 logger = logging.getLogger("tensorframes_tpu.fault_tolerance")
 
@@ -75,14 +75,6 @@ DEFAULT_MIN_SPLIT_ROWS = 16
 DEFAULT_QUARANTINE_AFTER = 3
 
 
-def _env_int(name: str, default: int, floor: int = 0) -> int:
-    raw = os.environ.get(name, "")
-    try:
-        return max(floor, int(raw))
-    except ValueError:
-        return default
-
-
 def block_retries() -> int:
     """Retries per block dispatch (``TFS_BLOCK_RETRIES``, >= 0)."""
     return _env_int(ENV_RETRIES, DEFAULT_RETRIES)
@@ -90,10 +82,7 @@ def block_retries() -> int:
 
 def block_backoff_s() -> float:
     """Base backoff between block retries (``TFS_BLOCK_BACKOFF_S``)."""
-    try:
-        return max(0.0, float(os.environ.get(ENV_BACKOFF, "")))
-    except ValueError:
-        return DEFAULT_BACKOFF_S
+    return _env_float(ENV_BACKOFF, DEFAULT_BACKOFF_S)
 
 
 def min_split_rows() -> int:
@@ -185,11 +174,18 @@ class FrameRetrySession:
         lo, hi = row_range if row_range is not None else (0, n_rows)
         attempt = 0
         while True:
+            # cooperative cancellation: every attempt (first try and
+            # every retry) is a checkpoint, so a request whose deadline
+            # passed during a block's compute or backoff sleep surfaces
+            # DeadlineExceeded here instead of burning retry budget
+            cancellation.checkpoint()
             dev_i = device() if callable(device) else device
             try:
                 faults.maybe_inject(bi, attempt, dev_i, n_rows)
                 return attempt_fn(attempt, dev_i)
             except BaseException as exc:  # noqa: BLE001 - classified below
+                if isinstance(exc, cancellation.Cancelled):
+                    raise  # a cancel is an instruction, not a failure
                 if faults.is_oom(exc):
                     if oom_split is not None:
                         return oom_split(exc)
@@ -237,6 +233,10 @@ class FrameRetrySession:
                     delay,
                     exc,
                 )
+                # never sleep a backoff for a request that is already
+                # cancelled / past deadline (the loop-top checkpoint
+                # would catch it anyway, but only after the sleep)
+                cancellation.checkpoint()
                 self._sleep(delay)
                 attempt += 1
 

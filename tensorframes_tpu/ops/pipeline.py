@@ -60,7 +60,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import dtypes, observability
+from .. import cancellation, dtypes, observability
 from ..frame import TensorFrame, is_device_array
 from ..program import Program
 from ..schema import ColumnInfo, Schema
@@ -99,6 +99,18 @@ class _SchemaView:
 
 def _block_info(name: str, st, cell_shape) -> ColumnInfo:
     return ColumnInfo(name, st, Shape(cell_shape).prepend(UNKNOWN))
+
+
+def _reduce_src_cols(program, bases, suffix: str) -> Dict[str, str]:
+    """base -> source chain column for a terminal reduce stage,
+    honouring feed-dict renames (round 11): ``inputs={"x_input":
+    "data"}`` folds the chain's ``data`` column into output ``x``."""
+    out = {}
+    for b in bases:
+        n = f"{b}{suffix}"
+        col = program.column_for_input(n)
+        out[b] = b if col == n else col
+    return out
 
 
 class Pipeline:
@@ -389,7 +401,15 @@ class Pipeline:
                     for n in st.program.input_names
                 ]
             else:
-                refs = list(st.reduced_bases)
+                # reduce stages read their feed-RESOLVED source columns
+                # (round 11): the bases alone would prune a renamed
+                # source out of the staged trace inputs
+                suffix = "_input" if st.kind == "reduce_blocks" else "_1"
+                refs = list(
+                    _reduce_src_cols(
+                        st.program, st.reduced_bases, suffix
+                    ).values()
+                )
             needed.update(refs)
         if not self._row_stage:
             needed.update(
@@ -445,9 +465,10 @@ class Pipeline:
                 ]
             elif st.kind == "reduce_blocks":
                 program, bases = st.program, list(st.reduced_bases)
+                srcs = _reduce_src_cols(program, bases, "_input")
                 partials = [
                     program.call(
-                        {f"{b}_input": blk[b] for b in bases}, params
+                        {f"{b}_input": blk[srcs[b]] for b in bases}, params
                     )
                     for blk in blocks
                     if next(iter(blk.values())).shape[0] > 0
@@ -467,6 +488,7 @@ class Pipeline:
                     row = program.call(stacked, params)
             elif st.kind == "reduce_rows":
                 program, bases = st.program, list(st.reduced_bases)
+                srcs = _reduce_src_cols(program, bases, "_1")
                 pairfn = _DEFAULT._pair_call(program, bases)
                 fold = (
                     _DEFAULT._tree_fold
@@ -474,7 +496,7 @@ class Pipeline:
                     else _DEFAULT._seq_fold
                 )
                 partials = [
-                    fold(pairfn, {b: blk[b] for b in bases}, params)
+                    fold(pairfn, {b: blk[srcs[b]] for b in bases}, params)
                     for blk in blocks
                     if next(iter(blk.values())).shape[0] > 0
                 ]
@@ -867,6 +889,7 @@ class Pipeline:
             eff_assign: List[int] = []
             shard_hits = 0
             for bi in range(nb):
+                cancellation.checkpoint()  # block boundary (pooled chain)
                 di = assignment[bi]
                 if cache is not None:
                     di_eff = pool.effective_device(di) if session else di

@@ -140,16 +140,27 @@ def check_reduce_rows(program: Program, frame: TensorFrame) -> Dict[str, ColumnI
                 f"{base}_1 and {base}_2; found only suffix(es) "
                 f"{sorted(halves)}."
             )
+        # feed-dict rename (round 11): both halves of a pair must feed
+        # from the SAME column (the pairwise fold has one source)
+        c1 = program.column_for_input(f"{base}_1")
+        c2 = program.column_for_input(f"{base}_2")
+        col = base if c1 == f"{base}_1" else c1
+        col2 = base if c2 == f"{base}_2" else c2
+        if col != col2:
+            raise ValidationError(
+                f"reduce_rows: inputs {base}_1/{base}_2 must feed from one "
+                f"column; the feed maps them to {col!r} and {col2!r}."
+            )
         schema = frame.schema
-        if base not in schema:
+        if col not in schema:
             raise ValidationError(
                 f"reduce_rows: inputs {base}_1/{base}_2 refer to column "
-                f"{base!r}, which does not exist. Available: {schema.names}."
+                f"{col!r}, which does not exist. Available: {schema.names}."
             )
-        ci = schema[base]
+        ci = schema[col]
         if not ci.is_analyzed:
             raise ValidationError(
-                f"reduce_rows: column {base!r} has un-analyzed cell shape "
+                f"reduce_rows: column {col!r} has un-analyzed cell shape "
                 f"{ci.cell_shape}; run analyze(frame) first."
             )
         outputs[base] = ci
@@ -196,21 +207,29 @@ def check_reduce_blocks(
                 f"(Operations.scala:98-108)."
             )
         base = n[: -len("_input")]
+        # feed-dict rename (round 11): ``inputs={"x_input": "data"}``
+        # feeds the block of column ``data`` — the naming convention is
+        # the default mapping, not a restriction.  The returned
+        # ColumnInfo keeps the RESOLVED column name, which is what the
+        # engine's block reads key on.
+        col = program.column_for_input(n)
+        if col == n:
+            col = base
         schema = frame.schema
-        if base not in schema:
+        if col not in schema:
             raise ValidationError(
-                f"{verb}: input {n!r} refers to column {base!r}, which does "
+                f"{verb}: input {n!r} refers to column {col!r}, which does "
                 f"not exist. Available: {schema.names}."
             )
-        ci = schema[base]
+        ci = schema[col]
         if not ci.is_analyzed:
             raise ValidationError(
-                f"{verb}: column {base!r} has un-analyzed cell shape "
+                f"{verb}: column {col!r} has un-analyzed cell shape "
                 f"{ci.cell_shape}; run analyze(frame) first."
             )
         if not ci.scalar_type.device_ok:
             raise ValidationError(
-                f"{verb}: column {base!r} is host-only ({ci.scalar_type}) and "
+                f"{verb}: column {col!r} is host-only ({ci.scalar_type}) and "
                 f"cannot be reduced on device."
             )
         outputs[base] = ci

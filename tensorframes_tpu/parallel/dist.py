@@ -415,7 +415,7 @@ class MeshExecutor(Executor):
         bases, reduced, run = self._reduce_rows_setup(program, frame, mode)
         n = frame.num_rows
         d = self._num_shards
-        cols = {b: self._column_array(frame, b, reduced[b]) for b in bases}
+        cols = {b: self._column_array(frame, reduced[b].name, reduced[b]) for b in bases}
         if n % d and mode != "sequential" and n >= d:
             final = self._split_reduce(run, cols, n)
         else:
@@ -434,7 +434,7 @@ class MeshExecutor(Executor):
         if self.mode == "global":
             n = frame.num_rows
             d = self._num_shards
-            cols = {b: self._column_array(frame, b, reduced[b]) for b in bases}
+            cols = {b: self._column_array(frame, reduced[b].name, reduced[b]) for b in bases}
             if n % d and n >= d:
                 final = self._split_reduce(run, cols, n)
             else:
@@ -478,7 +478,7 @@ class MeshExecutor(Executor):
         arrays = {}
         tails = {}
         for b in bases:
-            arr = self._column_array(frame, b, reduced[b])
+            arr = self._column_array(frame, reduced[b].name, reduced[b])
             arrays[b] = jax.device_put(arr[:n_even], sh)
             if n_even < n:
                 tails[b] = jnp.asarray(arr[n_even:])

@@ -30,6 +30,8 @@ import random
 import time
 from typing import Any, Callable, Optional, Tuple
 
+from . import cancellation
+
 _log = logging.getLogger("tensorframes_tpu.resilience")
 
 # exception text fragments that indicate the *runtime* (not the program)
@@ -120,7 +122,14 @@ class FailureDetector:
         restart budget.  An inconclusive exception with an explicit
         ``raise ... from`` cause defers to the cause's classification
         (bounded walk), so a wrapped staging/transfer failure keeps its
-        underlying transience."""
+        underlying transience.  Cooperative cancellation
+        (``cancellation.Cancelled``/``DeadlineExceeded``) is never
+        transient — its message contains "deadline exceeded" (a
+        transient marker for REAL infrastructure deadlines), but
+        retrying a deliberately cancelled request would defeat the
+        cancel, so the type check wins."""
+        if isinstance(exc, cancellation.Cancelled):
+            return False
         if isinstance(exc, _FATAL_TYPES):
             return False
         if isinstance(exc, _TRANSIENT_TYPES):

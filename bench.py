@@ -1693,6 +1693,176 @@ def bench_bridge_serving(jax, tfs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# config #15: out-of-core streaming frames — scoring + aggregate over a
+# frame >= 4x the enforced host budget, at bounded peak_host_bytes
+# ---------------------------------------------------------------------------
+
+
+def bench_stream_frames(jax, tfs) -> None:
+    """Round-12 evidence run: a parquet frame ~4-5x ``TFS_HOST_BUDGET``
+    is scored (streamed map -> parquet sink) and aggregated (incremental
+    monoid fold) without ever materialising on host.  The record carries
+    ``peak_host_bytes`` (must stay under the budget), the frame/budget
+    ratio, bit-identity of the streamed reduce+aggregate against the
+    fully-materialized reference, and streamed-vs-materialized scoring
+    throughput (the ~15%%-overhead claim is measured, not asserted)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from tensorframes_tpu import observability as obs, streaming
+    from tensorframes_tpu.frame import TensorFrame
+    from tensorframes_tpu.streaming import reader as stream_reader
+
+    rows, dim, groups = 400_000, 8, 64
+    budget = "6M"
+    budget_bytes = 6 << 20
+    tmp = tempfile.mkdtemp(prefix="tfs-bench15-")
+    try:
+        rng = np.random.RandomState(15)
+        # integer-valued f64 features: sums (and integer-weighted dot
+        # products) are exact in any association, so the bit-identity
+        # claims below are real contracts, not float luck
+        frame = tfs.TensorFrame.from_arrays(
+            {
+                "x": rng.randint(0, 16, (rows, dim)).astype(np.float64),
+                "k": rng.randint(0, groups, rows).astype(np.int32),
+            }
+        )
+        src = os.path.join(tmp, "src.parquet")
+        frame.to_parquet(src, row_group_size=32768)
+        frame_bytes = rows * (dim * 8 + 4)
+        del frame
+
+        w = jnp.asarray(rng.rand(dim).astype(np.float64))
+        wi = jnp.asarray(rng.randint(1, 4, dim).astype(np.float64))
+
+        def score(x):
+            # s: the throughput-realistic float score; c: an integer-
+            # exact linear score the aggregate leg can compare bitwise
+            return {"s": jnp.tanh(x) @ w, "c": x @ wi}
+
+        agg_fn = lambda c_input: {"c": c_input.sum(0)}  # noqa: E731
+        red_fn = lambda x_input: {"x": x_input.sum(0)}  # noqa: E731
+
+        # --- materialized reference: the same file->file scoring task
+        # (read parquet, score, write parquet), full frame on host
+        mat_out = os.path.join(tmp, "scored_mat.parquet")
+        t0 = time.perf_counter()
+        full = tfs.TensorFrame.from_parquet(src)
+        ref_scored = tfs.map_blocks(score, full)
+        ref_scored.select(["s", "c", "k"]).to_parquet(
+            mat_out, row_group_size=32768
+        )
+        mat_s = time.perf_counter() - t0
+        ref_agg = tfs.aggregate(
+            agg_fn, tfs.group_by(ref_scored.select(["c", "k"]), "k")
+        )
+        ref_agg_host = {
+            "k": np.asarray(ref_agg.column("k").data),
+            "c": np.asarray(ref_agg.column("c").data),
+        }
+        del full, ref_scored, ref_agg
+
+        # --- streamed run under the enforced budget: same file->file
+        # task, never holding more than the prefetch window of windows
+        prior_budget = os.environ.get("TFS_HOST_BUDGET")
+        os.environ["TFS_HOST_BUDGET"] = budget
+        try:
+            obs.reset_peak_host_bytes()
+            st = streaming.scan_parquet(src)
+            out_path = os.path.join(tmp, "scored.parquet")
+
+            class SelectSink(streaming.ParquetSink):
+                # write the same columns the materialized leg writes
+                # (drop the x passthrough): like-for-like file->file work
+                def write(self, fr):
+                    super().write(fr.select(["s", "c", "k"]))
+
+            t0 = time.perf_counter()
+            sunk = streaming.map_blocks(score, st, sink=SelectSink(out_path))
+            stream_s = time.perf_counter() - t0
+            # incremental aggregate over the scored stream + reduce over
+            # the source stream (both under the same budget)
+            got_agg = streaming.aggregate(
+                agg_fn,
+                streaming.scan_parquet(
+                    out_path, columns=["c", "k"]
+                ).group_by("k"),
+            )
+            red_stream = streaming.scan_parquet(src, columns=["x"])
+            got_red = streaming.reduce_blocks(red_fn, red_stream)
+            red_window = red_stream.window_rows
+            peak = obs.counters()["peak_host_bytes"]
+        finally:
+            # restore, don't clobber: a later config must see whatever
+            # the operator exported, not this config's leftovers
+            if prior_budget is None:
+                del os.environ["TFS_HOST_BUDGET"]
+            else:
+                os.environ["TFS_HOST_BUDGET"] = prior_budget
+        # reduce reference shares the reduce stream's block boundaries —
+        # the _combine_partials fold-shape contract makes this leg
+        # bit-identical for ANY values, not just exact ones
+        offsets = list(range(0, rows, red_window)) + [rows]
+        full = tfs.TensorFrame.from_parquet(src)
+        ref_frame = TensorFrame([full.column("x")], offsets)
+        ref_red = tfs.reduce_blocks(red_fn, ref_frame)
+        del full, ref_frame
+
+        agg_identical = bool(
+            np.array_equal(
+                ref_agg_host["k"], np.asarray(got_agg.column("k").data)
+            )
+            and np.array_equal(
+                ref_agg_host["c"], np.asarray(got_agg.column("c").data)
+            )
+        )
+        red_identical = bool(np.array_equal(ref_red["x"], got_red["x"]))
+        streamed_rps = rows / stream_s
+        mat_rps = rows / mat_s
+        _emit(
+            {
+                "metric": "stream_oversized_frame_score",
+                "value": round(streamed_rps, 1),
+                "unit": "rows/s",
+                # streamed/materialized: 1.0 = zero streaming overhead
+                "vs_baseline": round(streamed_rps / mat_rps, 4),
+                "config": 15,
+                "rows": rows,
+                "frame_bytes": frame_bytes,
+                "host_budget_bytes": budget_bytes,
+                "frame_over_budget_x": round(frame_bytes / budget_bytes, 2),
+                "window_rows": st.window_rows,
+                "windows": sunk["windows"],
+                "peak_host_bytes": peak,
+                "peak_under_budget": bool(peak <= budget_bytes),
+                "materialized_rows_per_s": round(mat_rps, 1),
+                "aggregate_bit_identical": agg_identical,
+                "reduce_bit_identical": red_identical,
+                "sink_bytes": sunk["bytes"],
+                "stream_knobs": {
+                    "TFS_STREAM_WINDOW": stream_reader.window_rows_default(),
+                    "TFS_HOST_BUDGET": budget,
+                },
+                "note": (
+                    "streamed map->parquet-sink scoring + incremental "
+                    "aggregate/reduce over a frame "
+                    f"{frame_bytes / budget_bytes:.1f}x the enforced host "
+                    "budget; peak_host_bytes is the measured high-water "
+                    "of live window columns, reduce compares against a "
+                    "materialized run with the stream's block boundaries "
+                    "(the shared _combine_partials fold shape)"
+                ),
+            }
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # config #4 (headline, printed last): Inception-v3 map_blocks scoring
 # ---------------------------------------------------------------------------
 
@@ -1990,6 +2160,7 @@ def main() -> None:
         bench_chaos,
         bench_frame_cache,
         bench_bridge_serving,
+        bench_stream_frames,
         bench_lm_train,
         bench_lm_train_wide,
         bench_decode,

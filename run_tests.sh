@@ -59,6 +59,21 @@ TFS_BRIDGE_DRAIN_S=5 TFS_BRIDGE_MAX_FRAMES=256 \
 JAX_PLATFORMS=cpu \
   python -m pytest tests/test_bridge_resilience.py tests/test_bridge.py -q
 
+# Streaming tier: the out-of-core streaming tests re-run with the
+# TFS_STREAM_*/TFS_SPILL_DIR/TFS_HOST_BUDGET knobs LIVE (tmpdir spill +
+# parquet fixtures) — the main suite runs them too, but with conftest
+# pinning the env knobs inert (tests pass knobs via monkeypatch there);
+# this tier proves the env wiring end to end: budget-clamped windows,
+# spool-to-disk re-iteration, and spill-backed cache eviction under a
+# tight HBM budget, on the forced 8-device host.
+echo "== streaming tier (out-of-core frames, env knobs live) =="
+TFS_SPILL_TMP="$(mktemp -d)"
+TFS_SPILL_DIR="$TFS_SPILL_TMP" TFS_STREAM_WINDOW=256 TFS_HOST_BUDGET=1M \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_stream_frames.py -q
+rm -rf "$TFS_SPILL_TMP"
+
 echo "== pytest =="
 exec python -m pytest tests/ -q --ignore=tests/test_device_pool.py \
   --ignore=tests/test_frame_cache.py "$@"

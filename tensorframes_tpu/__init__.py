@@ -47,6 +47,8 @@ from .program import (
 )
 from .schema import ColumnInfo, Schema, SchemaError
 from .shape import Shape, ShapeError, UNKNOWN
+from . import streaming
+from .streaming import scan_parquet
 
 __version__ = "0.1.0"
 
@@ -102,6 +104,8 @@ __all__ = [
     "Pipeline",
     "reduce_blocks",
     "reduce_rows",
+    "scan_parquet",
+    "streaming",
     "Program",
     "ProgramError",
     "GraphNodeSummary",

@@ -44,3 +44,46 @@ def env_opt_float(name: str) -> Optional[float]:
         return max(0.0, float(raw))
     except ValueError:
         return None
+
+
+_BYTE_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
+
+
+def parse_bytes(raw: str) -> Optional[int]:
+    """Parse a byte-count knob value — plain bytes or a ``K``/``M``/``G``
+    binary suffix — to an int >= 0, or None when malformed.  The one
+    parser behind every byte-budget knob (``TFS_HBM_BUDGET``,
+    ``TFS_HOST_BUDGET``), so the accepted grammar cannot drift."""
+    raw = raw.strip().lower()
+    if not raw:
+        return None
+    mult = 1
+    if raw[-1] in _BYTE_SUFFIX:
+        mult = _BYTE_SUFFIX[raw[-1]]
+        raw = raw[:-1]
+    try:
+        # OverflowError: "inf" / 9e999 overflow int(); malformed, not fatal
+        return max(0, int(float(raw) * mult))
+    except (ValueError, OverflowError):
+        return None
+
+
+def env_bytes(name: str, default: int = 0) -> int:
+    """Byte-count env knob via :func:`parse_bytes`; ``default`` when
+    unset, empty, or malformed."""
+    parsed = parse_bytes(os.environ.get(name, ""))
+    return default if parsed is None else parsed
+
+
+# one-shot warnings: the answer to "why is this knob not doing what I
+# asked" should land in the log exactly once per distinct cause, not
+# once per verb call / window / epoch.  One set for the process — the
+# keys are caller-namespaced strings.
+_warned_once: set = set()
+
+
+def warn_once(logger, key: str, msg: str, *args) -> None:
+    """``logger.warning(msg, *args)`` the first time ``key`` is seen."""
+    if key not in _warned_once:
+        _warned_once.add(key)
+        logger.warning(msg, *args)

@@ -276,10 +276,10 @@ class TensorFrame:
 
         return read_parquet(path, columns=columns, num_blocks=num_blocks)
 
-    def to_parquet(self, path) -> None:
+    def to_parquet(self, path, row_group_size: Optional[int] = None) -> None:
         from .io import write_parquet
 
-        write_parquet(self, path)
+        write_parquet(self, path, row_group_size=row_group_size)
 
     @staticmethod
     def from_pandas(df, num_blocks: int = 1) -> "TensorFrame":
@@ -514,9 +514,24 @@ class TensorFrame:
         if device is None and sharded is not False:
             devs = frame_cache.shard_devices(sharded)
             if devs:
-                cache = frame_cache.build(self, sorted(host), devices=devs)
+                # windowed frames (streaming/reader.py sets
+                # _host_windowed) have no durable host authority — the
+                # stream moves past the window — so their budget
+                # evictions must spill shard bytes to TFS_SPILL_DIR
+                # instead of dropping them (ops/frame_cache.py)
+                spill = None
+                if getattr(self, "_host_windowed", False):
+                    from .streaming import spill as _spill
+
+                    spill = _spill.store_if_configured()
+                cache = frame_cache.build(
+                    self, sorted(host), devices=devs, spill=spill
+                )
                 if cache is not None:
                     out = TensorFrame(list(self._columns), self._offsets)
+                    out._host_windowed = getattr(
+                        self, "_host_windowed", False
+                    )
                     return frame_cache.attach(out, cache)
         staged = prefetch.stage_columns(host, device)
         cols = [

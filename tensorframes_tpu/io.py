@@ -188,20 +188,87 @@ def frame_to_table(frame):
     return pa.table(arrays)
 
 
+def part_files(path) -> List[str]:
+    """Resolve ``path`` to an ordered list of parquet files: the file
+    itself, or — for a directory — its ``*.parquet`` part files in
+    sorted filename order (the deterministic row order both
+    ``read_parquet`` and ``streaming.scan_parquet`` share, so a
+    materialized read and a streamed scan of the same directory see the
+    same rows in the same order)."""
+    import os
+
+    p = str(path)
+    if os.path.isdir(p):
+        names = sorted(
+            n for n in os.listdir(p) if n.endswith((".parquet", ".pq"))
+        )
+        if not names:
+            raise SchemaError(
+                f"read_parquet: directory {p!r} holds no *.parquet part "
+                f"files"
+            )
+        return [os.path.join(p, n) for n in names]
+    return [p]
+
+
 def read_parquet(
     path, columns: Optional[Sequence[str]] = None, num_blocks: int = 1
 ):
-    """Parquet file/dir -> TensorFrame (``pyarrow.parquet.read_table``)."""
-    _pyarrow()  # consistent missing-dependency error surface
+    """Parquet file — or a directory of part files, concatenated in
+    sorted filename order — materialised as one TensorFrame.
+    Directories whose layout is richer than flat ``*.parquet`` parts
+    (hive partitions, other extensions) fall back to pyarrow's own
+    dataset discovery, preserving the pre-round-12 behavior.  For
+    sources that do not fit in host RAM, use
+    ``tensorframes_tpu.streaming.scan_parquet`` instead."""
+    pa = _pyarrow()  # consistent missing-dependency error surface
+    import os
+
     import pyarrow.parquet as pq
 
-    table = pq.read_table(path, columns=list(columns) if columns else None)
+    cols = list(columns) if columns else None
+    p = str(path)
+    paths = None
+    if os.path.isdir(p):
+        # the flat fast path (sorted *.parquet parts, deterministic
+        # order shared with streaming.scan_parquet) only applies to a
+        # directory of plain files; ANY subdirectory means a nested /
+        # partitioned layout that pyarrow's recursive dataset discovery
+        # must resolve — a flat read there would silently drop the
+        # nested files' rows
+        nested = any(
+            os.path.isdir(os.path.join(p, n)) for n in os.listdir(p)
+        )
+        if not nested:
+            try:
+                paths = part_files(p)
+            except SchemaError:
+                paths = None  # no *.parquet names: let pyarrow try
+    if paths is None:
+        table = pq.read_table(path, columns=cols)
+    else:
+        tables = [pq.read_table(q, columns=cols) for q in paths]
+        if len(tables) > 1:
+            # parts may list the same columns in different field order;
+            # concat_tables is order-sensitive (dataset discovery, the
+            # pre-round-12 path, unified by name) — align to part 0
+            first = tables[0].column_names
+            tables = [tables[0]] + [
+                t if t.column_names == first else t.select(first)
+                for t in tables[1:]
+            ]
+            table = pa.concat_tables(tables)
+        else:
+            table = tables[0]
     return table_to_frame(table, num_blocks=num_blocks)
 
 
-def write_parquet(frame, path) -> None:
-    """TensorFrame -> one parquet file."""
+def write_parquet(frame, path, row_group_size: Optional[int] = None) -> None:
+    """TensorFrame -> one parquet file.  ``row_group_size`` caps rows
+    per row group (pyarrow's default otherwise) — multi-row-group files
+    are what the streaming reader's window iteration and its tests
+    exercise."""
     _pyarrow()
     import pyarrow.parquet as pq
 
-    pq.write_table(frame_to_table(frame), path)
+    pq.write_table(frame_to_table(frame), path, row_group_size=row_group_size)

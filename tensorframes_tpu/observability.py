@@ -85,8 +85,19 @@ _counters: Dict[str, int] = {
     "bridge_cancels": 0,
     "bridge_idem_hits": 0,
     "bridge_verbs_executed": 0,
+    # out-of-core streaming frames (round 12): windows materialised, disk
+    # spill traffic, and the host-RAM high-water gauge that lets a bench
+    # record PROVE a streamed run never held the full frame on host
+    "stream_windows": 0,
+    "spill_bytes_written": 0,
+    "spill_bytes_read": 0,
+    "peak_host_bytes": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
+
+# live host bytes currently accounted to streaming windows (the gauge
+# behind peak_host_bytes); guarded by _counters_lock like the counters
+_live_host_bytes = 0
 
 # counters were single-thread-bumped until round 11; the bridge's
 # ThreadingTCPServer handlers now increment them concurrently, and an
@@ -225,6 +236,53 @@ def note_bridge_verb_executed() -> None:
     _bump("bridge_verbs_executed")
 
 
+def note_stream_window() -> None:
+    """One streamed window materialised into host columns by the
+    windowed reader (``streaming/reader.py``)."""
+    _bump("stream_windows")
+
+
+def note_spill_bytes_written(n: int) -> None:
+    """``n`` bytes written to ``TFS_SPILL_DIR`` (window spool files or
+    evicted cache shards) instead of being held in RAM / dropped."""
+    _bump("spill_bytes_written", int(n))
+
+
+def note_spill_bytes_read(n: int) -> None:
+    """``n`` bytes restored from ``TFS_SPILL_DIR``."""
+    _bump("spill_bytes_read", int(n))
+
+
+def note_host_window_bytes(delta: int) -> None:
+    """Adjust the live host-byte gauge by ``delta`` (positive when a
+    window's host columns materialise, negative when the consumer moves
+    past them).  ``peak_host_bytes`` tracks the high-water mark — the
+    fixed-memory evidence for streamed runs: a stream over an N-byte
+    frame that never exceeds a few windows of live bytes proves the
+    out-of-core contract, where a counter of total bytes could not."""
+    global _live_host_bytes
+    with _counters_lock:
+        _live_host_bytes = max(0, _live_host_bytes + int(delta))
+        if _live_host_bytes > _counters["peak_host_bytes"]:
+            _counters["peak_host_bytes"] = _live_host_bytes
+
+
+def live_host_bytes() -> int:
+    """The live host-byte gauge (streaming window columns currently
+    materialised)."""
+    with _counters_lock:
+        return _live_host_bytes
+
+
+def reset_peak_host_bytes() -> None:
+    """Re-base ``peak_host_bytes`` to the current live gauge so a bench
+    leg / test measures ITS OWN high-water, not an earlier run's.  (The
+    peak is a gauge, not a monotonic counter — it is deliberately
+    excluded from :func:`counters_delta`.)"""
+    with _counters_lock:
+        _counters["peak_host_bytes"] = _live_host_bytes
+
+
 @contextlib.contextmanager
 def suppress_trace_count():
     """Trace-counter suppression for analysis-time tracing (shape
@@ -310,6 +368,12 @@ def counters_delta(
             "bridge_cancels",
             "bridge_idem_hits",
             "bridge_verbs_executed",
+            # peak_host_bytes is a high-water GAUGE, not a monotonic
+            # counter, so it stays out of the delta (read it absolute
+            # from counters() after reset_peak_host_bytes())
+            "stream_windows",
+            "spill_bytes_written",
+            "spill_bytes_read",
         )
     }
 

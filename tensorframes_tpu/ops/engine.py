@@ -1965,17 +1965,27 @@ class Executor:
             # empty-partition guard inside (DebugRowOps:489-499); pooled
             # across local devices for host-fresh multi-block frames
             partials = self._reduce_partials(run, bases, reduced, frame, span)
-            if len(partials) == 1:
-                final = partials[0]
-            else:
-                stacked = {
-                    b: jnp.stack([p[b] for p in partials]) for b in bases
-                }
-                final = run(stacked)
+            final = self._combine_partials(run, bases, partials)
             span.mark("dispatch")
             out = {b: _np(final[b]) for b in bases}
             span.mark("sync")
             return out
+
+    def _combine_partials(
+        self, run, bases, partials: List[Dict[str, jnp.ndarray]]
+    ) -> Dict[str, jnp.ndarray]:
+        """The ONE final-combine shape of the reduce verbs: stack every
+        per-block partial in block order and re-apply ``run`` once.
+        Shared by ``reduce_rows``/``reduce_blocks`` and the streaming
+        incremental folds (``streaming/verbs.py``), which accumulate the
+        same per-block partials window by window — so a windowed reduce
+        is bit-identical to the materialized reduce over a frame with
+        the same block boundaries, by construction rather than by
+        numerical luck."""
+        if len(partials) == 1:
+            return partials[0]
+        stacked = {b: jnp.stack([p[b] for p in partials]) for b in bases}
+        return run(stacked)
 
     def _reduce_partials(
         self, run, bases, reduced, frame: TensorFrame, span
@@ -2257,13 +2267,7 @@ class Executor:
             # empty-partition guard inside (DebugRowOps:512-522); pooled
             # across local devices for host-fresh multi-block frames
             partials = self._reduce_partials(run, bases, reduced, frame, span)
-            if len(partials) == 1:
-                final = partials[0]
-            else:
-                stacked = {
-                    b: jnp.stack([p[b] for p in partials]) for b in bases
-                }
-                final = run(stacked)
+            final = self._combine_partials(run, bases, partials)
             span.mark("dispatch")
             out = {b: _np(final[b]) for b in bases}
             span.mark("sync")

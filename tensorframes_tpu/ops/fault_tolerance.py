@@ -38,6 +38,20 @@ failures), runs the same executable, and lands in the same block slot.
 Tests pin ``TFS_BLOCK_RETRIES=0`` (conftest) so trace-count fences stay
 deterministic; the chaos tier turns the knobs on.
 
+Streaming composition (round 12, ``tensorframes_tpu/streaming/``): the
+out-of-core verbs run each window through the engine unchanged, so
+every window's verb call builds its OWN :class:`FrameRetrySession` via
+:func:`frame_session`.  That per-window scoping is deliberate: the
+``retries x blocks`` frame budget bounds recovery *per window* — the
+unit whose source bytes are still at hand — rather than amortising one
+budget over an unbounded stream (where any fixed budget would either
+exhaust arbitrarily early or never bind).  It is the same shape as
+Spark's per-task retry budgets over a long job, and it keeps a
+mid-stream brownout from poisoning windows that have not arrived yet.
+Cancellation still preempts everything: a deadline that fires during a
+window's retries surfaces at the next attempt checkpoint and the sink
+stays at a window boundary (docs/RESILIENCE.md).
+
 Knobs:
 
 * ``TFS_BLOCK_RETRIES`` — retries per block (default 2; 0 disables the

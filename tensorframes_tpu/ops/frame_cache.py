@@ -66,6 +66,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import observability
+from ..envutil import parse_bytes, warn_once
 from . import device_pool
 
 logger = logging.getLogger("tensorframes_tpu.frame_cache")
@@ -73,40 +74,30 @@ logger = logging.getLogger("tensorframes_tpu.frame_cache")
 ENV_SHARDED = "TFS_CACHE_SHARDED"
 ENV_BUDGET = "TFS_HBM_BUDGET"
 
-_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
-
-_warned: set = set()
-
-
 def _warn_once(key: str, msg: str, *args) -> None:
-    if key not in _warned:
-        _warned.add(key)
-        logger.warning(msg, *args)
+    warn_once(logger, "frame_cache:" + key, msg, *args)
 
 
 def hbm_budget() -> int:
     """Resident-shard byte budget (``TFS_HBM_BUDGET``; 0 = unlimited).
 
-    Accepts plain bytes or a ``K``/``M``/``G`` binary suffix.  Read per
-    call so tests and bench legs can flip it mid-process."""
-    raw = os.environ.get(ENV_BUDGET, "").strip().lower()
-    if not raw:
+    Accepts plain bytes or a ``K``/``M``/``G`` binary suffix
+    (``envutil.parse_bytes``).  Read per call so tests and bench legs
+    can flip it mid-process."""
+    raw = os.environ.get(ENV_BUDGET, "")
+    if not raw.strip():
         return 0
-    mult = 1
-    if raw and raw[-1] in _SUFFIX:
-        mult = _SUFFIX[raw[-1]]
-        raw = raw[:-1]
-    try:
-        return max(0, int(float(raw) * mult))
-    except ValueError:
+    parsed = parse_bytes(raw)
+    if parsed is None:
         _warn_once(
             "budget:" + raw,
             "%s=%r is malformed; use bytes or a K/M/G suffix. "
             "Treating as unlimited.",
             ENV_BUDGET,
-            os.environ.get(ENV_BUDGET),
+            raw,
         )
         return 0
+    return parsed
 
 
 def shard_devices(explicit: Optional[bool] = None) -> List[Any]:
@@ -147,6 +138,13 @@ def shard_devices(explicit: Optional[bool] = None) -> List[Any]:
     return devs if len(devs) >= 2 else []
 
 
+def _delete_spill_files(spill, tag: str, spilled: set) -> None:
+    """GC finalizer body for spill-backed caches: remove whatever shard
+    files are still on disk (``delete`` tolerates already-gone keys)."""
+    for bi in list(spilled):
+        spill.delete(f"{tag}-{bi}")
+
+
 def array_nbytes(a) -> int:
     """Byte size of one (host or device) array."""
     nb = getattr(a, "nbytes", None)
@@ -165,13 +163,33 @@ class FrameCache:
     A cache is attached to exactly one :class:`~tensorframes_tpu.frame.
     TensorFrame` (``frame._cache``) whose host columns remain the
     authoritative copy; the engine consults :func:`active_cache` per
-    verb and falls back to host staging for any non-resident block."""
+    verb and falls back to host staging for any non-resident block.
+
+    ``spill`` (round 12, out-of-core streaming): a
+    :class:`tensorframes_tpu.streaming.spill.SpillStore` (or any object
+    with ``put``/``get``/``delete``).  With it set, the cache's frame is
+    declared to have NO durable host copy (a streamed window the reader
+    has moved past), so the budget LRU's eviction path cannot simply
+    drop a shard — :meth:`evict` writes the shard's bytes to disk first
+    and :meth:`shard` restores them (disk -> host -> affinity device,
+    re-charged against the budget) on the block's next use.  Without
+    ``spill`` the round-10 behavior is untouched: eviction is free
+    because the host columns are authoritative.
+
+    Known scope limit, deliberate for round 12: a ``TensorFrame``
+    object still pins its host column arrays for its own lifetime, so
+    while a windowed frame is LIVE its host copy could also serve
+    re-staging — the disk copy pays off against lifecycle, not liveness
+    (it is what survives once host-column release for windowed caches
+    lands; ROADMAP open item).  The mechanism, counters, and tests are
+    the contract this round establishes."""
 
     def __init__(
         self,
         devices: Sequence[Any],
         assignment: Sequence[int],
         adopted: bool = False,
+        spill: Optional[Any] = None,
     ):
         self.devices = list(devices)
         self.assignment = list(assignment)
@@ -180,6 +198,17 @@ class FrameCache:
         )
         self.nbytes: List[int] = [0] * len(self.assignment)
         self.adopted = adopted
+        self.spill = spill
+        self._spilled: set = set()
+        self._spill_tag = f"shard-{os.getpid()}-{id(self):x}"
+        if spill is not None:
+            # a cache dropped without uncache() must not leak its spill
+            # files on disk; the finalizer holds no reference back to
+            # the cache (the set is shared, not captured via self)
+            weakref.finalize(
+                self, _delete_spill_files, spill, self._spill_tag,
+                self._spilled,
+            )
 
     # -- residency -----------------------------------------------------------
 
@@ -194,17 +223,58 @@ class FrameCache:
         self.nbytes[bi] = nbytes
         return True
 
+    def _spill_key(self, bi: int) -> str:
+        return f"{self._spill_tag}-{bi}"
+
     def shard(self, bi: int) -> Optional[Dict[str, Any]]:
-        """Block ``bi``'s resident shard (LRU-touched), or None."""
+        """Block ``bi``'s resident shard (LRU-touched), or None.  A
+        spill-backed cache restores an evicted shard from disk —
+        disk -> host -> the block's affinity device, re-charged against
+        the budget (which may evict another shard) — so a windowed
+        frame's bytes survive LRU churn instead of vanishing.  The disk
+        copy is KEPT after a restore: shards are immutable, so it stays
+        valid and the next eviction of this block is a free pointer
+        drop instead of a full re-serialize (``_spilled`` therefore
+        means "valid disk copy exists", resident or not)."""
         s = self.blocks[bi]
         if s is not None:
             _budget.touch(self, bi)
-        return s
+            return s
+        if self.spill is not None and bi in self._spilled:
+            host = self.spill.get(self._spill_key(bi))
+            if host is None:  # spill file lost: nothing to restore
+                self._spilled.discard(bi)
+                return None
+            import jax
+
+            dev = self.devices[self.assignment[bi]]
+            staged = {}
+            for name, arr in host.items():
+                observability.note_h2d_bytes(arr.nbytes)
+                staged[name] = jax.device_put(arr, dev)
+            if self.insert(bi, staged):
+                return self.blocks[bi]
+            # the budget cannot hold it even now — the disk copy stays
+            # the only copy; the caller falls back
+        return None
 
     def evict(self, bi: int) -> None:
         """Drop block ``bi``'s shard (budget eviction / release path).
-        The authoritative host copy is untouched; the block re-stages
-        from host on next use."""
+        With a durable host copy that is free; a spill-backed cache
+        (windowed frame, no host authority) writes the shard to
+        ``TFS_SPILL_DIR`` first so the bytes survive — unless a valid
+        disk copy from an earlier eviction already exists (shards are
+        immutable, so re-writing identical bytes would be pure I/O
+        waste in exactly the tight-budget thrash regime spill serves)."""
+        shard = self.blocks[bi]
+        if (
+            shard is not None
+            and self.spill is not None
+            and bi not in self._spilled
+        ):
+            host = {k: np.asarray(v) for k, v in shard.items()}
+            self.spill.put(self._spill_key(bi), host)
+            self._spilled.add(bi)
         self.blocks[bi] = None
         self.nbytes[bi] = 0
 
@@ -214,6 +284,10 @@ class FrameCache:
         for bi in range(len(self.blocks)):
             self.blocks[bi] = None
             self.nbytes[bi] = 0
+        if self.spill is not None:
+            for bi in sorted(self._spilled):
+                self.spill.delete(self._spill_key(bi))
+            self._spilled.clear()
 
     # -- stats ---------------------------------------------------------------
 
@@ -229,13 +303,16 @@ class FrameCache:
 
     def record(self) -> dict:
         """The ``frame_cache`` span annotation body."""
-        return {
+        rec = {
             "devices": len(self.devices),
             "blocks": len(self.blocks),
             "resident_blocks": self.resident_blocks(),
             "resident_bytes_per_device": self.resident_bytes_per_device(),
             "adopted": self.adopted,
         }
+        if self.spill is not None:
+            rec["spilled_blocks"] = len(self._spilled)
+        return rec
 
 
 class _HbmBudget:
@@ -253,28 +330,32 @@ class _HbmBudget:
         self._entries: "collections.OrderedDict" = collections.OrderedDict()
         self.total_bytes = 0
 
-    def _drop(self, key, evict: bool) -> None:
+    def _drop(self, key) -> Optional[tuple]:
+        """Unaccount one entry (lock held); returns ``(cache, bi)``
+        when the caller should run the cache's eviction hook, or None
+        for dead/refunded entries.  The hook runs OUTSIDE the lock —
+        spill-backed eviction does disk I/O (``FrameCache.evict``), and
+        a process-wide lock must never wait on a disk write."""
         ref, bi, nbytes = self._entries.pop(key)
         self.total_bytes -= nbytes
         cache = ref()
-        if cache is not None and evict:
-            cache.evict(bi)
-            observability.note_cache_eviction()
+        return (cache, bi) if cache is not None else None
 
     def _prune(self) -> None:
         """Drop entries whose cache was garbage-collected without an
         explicit ``uncache()`` — their shards are already freed, so they
         must not keep pinning budget."""
         for key in [k for k, v in self._entries.items() if v[0]() is None]:
-            self._drop(key, evict=False)
+            self._drop(key)
 
     def charge(self, cache: FrameCache, bi: int, nbytes: int) -> bool:
         budget = hbm_budget()
+        evictions = []
         with self._lock:
             self._prune()
             key = (id(cache), bi)
             if key in self._entries:
-                self._drop(key, evict=False)
+                self._drop(key)  # re-insert: refund, no eviction hook
             if budget and nbytes > budget:
                 # refusal, not eviction: the shard was never resident,
                 # so the eviction counter (LRU churn evidence) stays put
@@ -282,11 +363,18 @@ class _HbmBudget:
             if budget:
                 while self.total_bytes + nbytes > budget and self._entries:
                     oldest = next(iter(self._entries))
-                    dead = self._entries[oldest][0]() is None
-                    self._drop(oldest, evict=not dead)
+                    victim = self._drop(oldest)
+                    if victim is not None:
+                        evictions.append(victim)
             self._entries[key] = (weakref.ref(cache), bi, nbytes)
             self.total_bytes += nbytes
-            return True
+        # eviction hooks after the lock is released: a reader that races
+        # in between sees either the still-resident shard (fine: shards
+        # are immutable) or the evicted/spilled state
+        for victim, vbi in evictions:
+            victim.evict(vbi)
+            observability.note_cache_eviction()
+        return True
 
     def touch(self, cache: FrameCache, bi: int) -> None:
         with self._lock:
@@ -299,7 +387,7 @@ class _HbmBudget:
             for key in [
                 k for k in self._entries if k[0] == id(cache)
             ]:
-                self._drop(key, evict=False)
+                self._drop(key)  # refund only: release() is not eviction
 
 
 _budget = _HbmBudget()
@@ -330,14 +418,19 @@ def attach(frame, cache: Optional[FrameCache]):
 def active_cache(frame) -> Optional[FrameCache]:
     """The frame's sharded cache when it is usable: attached, block
     count matching the frame's current partitioning, and at least one
-    resident shard.  Anything else (fully evicted, repartitioned-away)
-    returns None and the host paths take over."""
+    resident — or spill-restorable — shard.  Anything else (fully
+    evicted with no spill, repartitioned-away) returns None and the
+    host paths take over.  The spilled clause matters for windowed
+    frames: a spill-backed cache whose every shard was evicted to disk
+    must still dispatch through the affinity path, where ``shard()``
+    restores blocks from ``TFS_SPILL_DIR`` — otherwise the spilled
+    bytes would be unreachable dead weight."""
     cache = getattr(frame, "_cache", None)
     if cache is None:
         return None
     if len(cache.assignment) != frame.num_blocks:
         return None
-    if cache.resident_blocks() == 0:
+    if cache.resident_blocks() == 0 and not cache._spilled:
         return None
     return cache
 
@@ -346,6 +439,7 @@ def build(
     frame,
     col_names: Sequence[str],
     devices: Optional[Sequence[Any]] = None,
+    spill: Optional[Any] = None,
 ) -> Optional[FrameCache]:
     """Stage ``col_names``'s block slices onto their block-affinity
     devices and return the resulting cache (None when sharding cannot
@@ -357,7 +451,11 @@ def build(
     Transfers are async ``device_put`` calls issued back to back per
     device (the ``stage_columns`` policy, at block granularity) and are
     the one H2D cost a cached loop ever pays (counted in
-    ``h2d_bytes_staged``)."""
+    ``h2d_bytes_staged``).
+
+    ``spill``: a disk store for evicted shards — passed by
+    ``frame.cache()`` for windowed frames (no durable host authority;
+    see :class:`FrameCache`)."""
     import jax
 
     if devices is None:
@@ -371,7 +469,7 @@ def build(
     ):
         return None
     assignment = device_pool.assign(frame.block_sizes, len(devices))
-    cache = FrameCache(devices, assignment)
+    cache = FrameCache(devices, assignment, spill=spill)
     names = list(col_names)
     for bi in range(frame.num_blocks):
         block = frame.block(bi)

@@ -602,6 +602,35 @@ class Pipeline:
             for st in self._stages
         ]
 
+    def with_frame(self, frame: TensorFrame) -> "Pipeline":
+        """Re-bind this chain to a new source frame with the same
+        column layout — the streaming window loop's entry point
+        (``streaming.run_pipeline`` runs ``pipe.with_frame(window).
+        run()`` per window).
+
+        Stages are shared BY REFERENCE: their ``Program`` objects — and
+        therefore every ``cached_jit``/AOT executable those programs
+        hold — stay hot across windows, which is what makes a
+        per-window pipeline cheap (full windows share one row count, so
+        one executable serves the stream).  The per-Pipeline compiled
+        plans are deliberately NOT carried over: they may close over the
+        bound frame, and a stale closure would silently read the old
+        window's data."""
+        if frame.column_names != self._frame.column_names:
+            raise ValidationError(
+                f"pipeline.with_frame: the new frame's columns "
+                f"{frame.column_names} do not match the chain's source "
+                f"columns {self._frame.column_names}"
+            )
+        return Pipeline(
+            frame,
+            self._stages,
+            dict(self._visible),
+            dict(self._from_source),
+            self._row_stage,
+            self._engine,
+        )
+
     # ----------------------------------------------------------- execution --
 
     def run(self):

@@ -142,24 +142,31 @@ class Prefetcher:
     total worker staging wall time and ``wait_s`` is total consumer time
     blocked waiting for a staged item; :meth:`overlap_ratio` is the
     fraction of staging time hidden behind the consumer's own work.
+
+    ``num_items=None`` (round 12, the streaming window reader): the item
+    count is unknown upfront — ``stage(i)`` is called for ``i = 0, 1,
+    ...`` until it raises ``StopIteration``, which ends the iteration
+    cleanly (the windowed reader pulls from an unbounded Arrow batch
+    source, so only the source knows when it is dry).  ``stats["items"]``
+    then counts the items actually staged.
     """
 
     def __init__(
         self,
         stage: Callable[[int], Any],
-        num_items: int,
+        num_items: Optional[int],
         depth: Optional[int] = None,
         name: str = "tfs-prefetch",
     ):
         self._stage = stage
-        self._n = int(num_items)
+        self._n = None if num_items is None else int(num_items)
         self._depth = prefetch_depth() if depth is None else max(0, depth)
         # thread name: the device-pool scheduler runs one lane per device
         # ("tfs-pool-d<k>"), and distinguishable names matter in py-spy /
         # profiler dumps when several lanes stage concurrently
         self._name = name
         self.stats: Dict[str, Any] = {
-            "items": self._n,
+            "items": 0 if self._n is None else self._n,
             "depth": self._depth,
             "stage_s": 0.0,
             "wait_s": 0.0,
@@ -172,53 +179,86 @@ class Prefetcher:
     # -- iteration -----------------------------------------------------------
 
     def __iter__(self):
-        if self._depth <= 0 or self._n <= 1:
+        if self._depth <= 0 or (self._n is not None and self._n <= 1):
             # synchronous fallback: stage inline on the consumer thread
-            for i in range(self._n):
+            i = 0
+            while self._n is None or i < self._n:
                 t0 = time.perf_counter()
-                v = self._stage(i)
+                try:
+                    v = self._stage(i)
+                except StopIteration as e:
+                    if self._n is not None:
+                        # a BOUNDED stage running dry early is a bug in
+                        # the stage, not clean exhaustion — silently
+                        # truncating would hand the consumer a short
+                        # frame with no diagnosis
+                        raise StagingError(
+                            f"{self._name}: staging item {i} raised "
+                            f"StopIteration before the declared "
+                            f"{self._n} items"
+                        ) from e
+                    return  # unbounded source exhausted
                 dt = time.perf_counter() - t0
                 self.stats["stage_s"] += dt
                 self.stats["wait_s"] += dt
+                if self._n is None:
+                    self.stats["items"] += 1
                 yield v
+                i += 1
             return
         yield from self._iter_threaded()
 
     def _iter_threaded(self):
         q: "queue.Queue" = queue.Queue(maxsize=self._depth)
         stop = threading.Event()
+        end = object()  # unbounded-mode exhaustion sentinel
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
-            i = -1
+            i = 0
             try:
-                for i in range(self._n):
+                while self._n is None or i < self._n:
                     if stop.is_set():
                         return
                     t0 = time.perf_counter()
-                    v = self._stage(i)
+                    try:
+                        v = self._stage(i)
+                    except StopIteration:
+                        if self._n is not None:
+                            # bounded mode: early exhaustion is a stage
+                            # bug — re-raise so the outer handler ships
+                            # the error sentinel (the consumer would
+                            # otherwise block on the queue forever)
+                            raise
+                        break  # unbounded source exhausted
                     self.stats["stage_s"] += time.perf_counter() - t0
-                    while not stop.is_set():
-                        try:
-                            q.put((v, None), timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
+                    if self._n is None:
+                        self.stats["items"] += 1
+                    if not put((v, None)):
+                        return
+                    i += 1
+                if self._n is None:
+                    put((end, None))
             except BaseException as e:  # propagate to the consumer,
                 # tagged with the failing item so the consumer can
                 # re-raise with block context (StagingError)
-                while not stop.is_set():
-                    try:
-                        q.put((None, (i, e)), timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                put((None, (i, e)))
 
         t = threading.Thread(
             target=worker, name=self._name, daemon=True
         )
         t.start()
         try:
-            for _ in range(self._n):
+            produced = 0
+            while self._n is None or produced < self._n:
                 t0 = time.perf_counter()
                 v, err = q.get()
                 self.stats["wait_s"] += time.perf_counter() - t0
@@ -234,7 +274,10 @@ class Prefetcher:
                         f"{self._name}: staging block {i} failed: "
                         f"{type(e).__name__}: {e}"
                     ) from e
+                if v is end:
+                    return
                 yield v
+                produced += 1
         finally:
             stop.set()
             # unblock a worker stuck on a full queue, then reap it

@@ -1,0 +1,56 @@
+"""Out-of-core streaming frames (round 12).
+
+Frames larger than host RAM — and unbounded sources — run through the
+six verbs at fixed memory: a windowed reader (:func:`scan_parquet` /
+:func:`from_batches`) iterates Arrow data ``TFS_STREAM_WINDOW`` rows at
+a time through the engine's existing prefetch/bucketing/pool/fault
+machinery, the map verbs stream window -> device -> sink, the reduce
+verbs fold incrementally through the engine's exact partial-combine
+shape, and ``TFS_SPILL_DIR`` gives evicted shards and one-shot sources a
+disk home.  See the submodule docstrings for the contracts:
+
+* :mod:`~tensorframes_tpu.streaming.reader` — windowing, host-budget
+  clamp, ``peak_host_bytes`` accounting;
+* :mod:`~tensorframes_tpu.streaming.verbs` — the six streamed verbs and
+  their bit-identity story;
+* :mod:`~tensorframes_tpu.streaming.sink` — parquet / collect sinks and
+  window-boundary durability;
+* :mod:`~tensorframes_tpu.streaming.spill` — the disk spill store.
+"""
+
+from .reader import (
+    StreamFrame,
+    StreamGroupedFrame,
+    frame_host_bytes,
+    from_batches,
+    scan_parquet,
+)
+from .sink import CollectSink, ParquetSink
+from .spill import SpillStore
+from .verbs import (
+    aggregate,
+    map_blocks,
+    map_blocks_trimmed,
+    map_rows,
+    reduce_blocks,
+    reduce_rows,
+    run_pipeline,
+)
+
+__all__ = [
+    "StreamFrame",
+    "StreamGroupedFrame",
+    "CollectSink",
+    "ParquetSink",
+    "SpillStore",
+    "aggregate",
+    "frame_host_bytes",
+    "from_batches",
+    "map_blocks",
+    "map_blocks_trimmed",
+    "map_rows",
+    "reduce_blocks",
+    "reduce_rows",
+    "run_pipeline",
+    "scan_parquet",
+]

@@ -1,0 +1,579 @@
+"""Windowed out-of-core frame reader: parquet / Arrow sources at fixed
+host memory.
+
+Every pre-round-12 entry path (``io.read_parquet``, ``TensorFrame.
+from_arrays``, ``data.py``) materialises the FULL frame in host RAM
+before the first verb runs — the biggest scenario gap against the
+reference's model of per-partition execution over tables that do not fit
+on one machine (PAPER.md §0).  :class:`StreamFrame` closes it: a source
+of Arrow record batches (a parquet file, a directory of part files, or
+any batch iterator — bounded or not) is re-windowed into consecutive
+``TFS_STREAM_WINDOW``-row windows, each materialised as an ordinary
+:class:`~tensorframes_tpu.frame.TensorFrame` just long enough for a verb
+to consume it.  At no point do more than ``prefetch depth + 1`` windows
+of host columns exist, whatever the source size — the high-water gauge
+``peak_host_bytes`` (``observability``) is the proof.
+
+Design points:
+
+* **windows ride the existing machinery.**  A window is a real
+  TensorFrame (built per batch through ``io._column_from_arrow``, the
+  same Arrow mapping as ``read_parquet``), so the verbs' prefetch lanes,
+  geometric bucketing (every full window has the SAME row count, so one
+  hot executable serves the whole stream), device pool, fault-tolerance
+  sessions, and cancellation checkpoints all apply per window with zero
+  new dispatch code.
+* **window building overlaps compute.**  The reader stages windows
+  through a :class:`~tensorframes_tpu.ops.prefetch.Prefetcher`
+  (``name="tfs-stream-window"``) — parquet decode + column build for
+  window k+1 happen on the staging thread while window k's verb
+  dispatches.
+* **re-iteration.**  Parquet-backed streams re-scan the files (disk is
+  the durable copy).  One-shot sources (generators, unbounded batch
+  iterators) are spooled window-by-window to ``TFS_SPILL_DIR`` parquet
+  part files on the first pass (``spill_bytes_written``), so epoch loops
+  replay from local disk; without a spill dir a second pass raises.
+
+Knobs:
+
+* ``TFS_STREAM_WINDOW`` — rows per window (default 65536).
+* ``TFS_STREAM_BLOCKS`` — blocks each window partitions into (default 1;
+  raise it to let the device pool dispatch within a window).
+* ``TFS_HOST_BUDGET`` — host-RAM byte budget for live window columns
+  (``K``/``M``/``G`` suffixes; 0/unset = no clamp).  The effective
+  window is clamped so ``(prefetch depth + 2)`` windows fit, and
+  ``peak_host_bytes`` measures what was actually held.  Accounting
+  scope, precisely: the gauge covers MATERIALISED window columns; the
+  transient Arrow read buffer (at most ~one window + one source batch)
+  rides on top of it.  ``scan_parquet`` clamps its batch-read hint by
+  the same budget rule so that buffer is budget-shaped too;
+  ``from_batches`` reads whatever granularity the caller's source
+  yields — a source that hands over one giant table buffers that table,
+  and no window clamp can shrink what the caller already built.
+* ``TFS_SPILL_DIR`` — see :mod:`tensorframes_tpu.streaming.spill`.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import weakref
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .. import observability
+from ..envutil import env_bytes, env_int, warn_once
+from ..frame import TensorFrame
+from ..ops import prefetch
+from ..ops.validation import ValidationError
+from . import spill as _spill
+
+logger = logging.getLogger("tensorframes_tpu.streaming")
+
+ENV_WINDOW = "TFS_STREAM_WINDOW"
+ENV_BLOCKS = "TFS_STREAM_BLOCKS"
+ENV_HOST_BUDGET = "TFS_HOST_BUDGET"
+
+DEFAULT_WINDOW_ROWS = 65536
+
+def _log_once(key: str, msg: str, *args) -> None:
+    """One-shot log (the shared ``envutil.warn_once``): "why is this
+    stream slower / smaller-windowed than asked" lands in the log
+    exactly once per distinct cause, not once per window."""
+    warn_once(logger, "streaming:" + key, msg, *args)
+
+
+def window_rows_default() -> int:
+    """Rows per stream window (``TFS_STREAM_WINDOW``, >= 1)."""
+    return env_int(ENV_WINDOW, DEFAULT_WINDOW_ROWS, floor=1)
+
+
+def stream_blocks() -> int:
+    """Blocks per window (``TFS_STREAM_BLOCKS``, >= 1)."""
+    return env_int(ENV_BLOCKS, 1, floor=1)
+
+
+def host_budget() -> int:
+    """Host-RAM byte budget for live window columns
+    (``TFS_HOST_BUDGET``; 0 = no clamp)."""
+    return env_bytes(ENV_HOST_BUDGET, 0)
+
+
+def frame_host_bytes(frame: TensorFrame) -> int:
+    """Host bytes held by ``frame``'s columns (device-resident columns
+    count 0 — they are HBM, accounted by ``TFS_HBM_BUDGET``)."""
+    total = 0
+    for c in frame.columns:
+        d = c.data
+        if isinstance(d, np.ndarray):
+            if d.dtype == object:
+                for cell in d:
+                    total += _cell_bytes(cell)
+            else:
+                total += d.nbytes
+        elif isinstance(d, list):
+            for cell in d:
+                total += _cell_bytes(cell)
+    return total
+
+
+def _cell_bytes(cell: Any) -> int:
+    nb = getattr(cell, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    if isinstance(cell, (bytes, str)):
+        return len(cell)
+    return 0
+
+
+def _row_bytes_estimate(schema) -> int:
+    """Rough host bytes per row from an Arrow schema — fixed-width
+    fields exactly, variable-width (strings, lists) a 32-byte guess.
+    Only used to clamp the window under ``TFS_HOST_BUDGET``; the real
+    footprint is measured by ``peak_host_bytes``."""
+    import pyarrow as pa
+
+    total = 0
+    for field in schema:
+        t = field.type
+        mult = 1
+        while pa.types.is_fixed_size_list(t):
+            mult *= t.list_size
+            t = t.value_type
+        try:
+            width = t.bit_width // 8
+        except (ValueError, AttributeError):
+            width = 32  # variable-width: strings, lists, binaries
+        total += max(width, 1) * mult
+    return max(total, 1)
+
+
+def clamped_window(requested: int, schema, label: str = "stream") -> int:
+    """Clamp a requested window row count so ``prefetch depth + 2``
+    windows of ``schema``-shaped rows fit ``TFS_HOST_BUDGET`` (logged
+    once) — the enforcement half of the fixed-memory contract;
+    ``peak_host_bytes`` is the evidence half.  Shared by the window
+    iterator and ``scan_parquet``'s batch-size hint, so the Arrow read
+    granularity respects the budget too."""
+    w = requested
+    budget = host_budget()
+    if budget > 0:
+        concurrent = prefetch.prefetch_depth() + 2
+        fit = max(1, budget // (concurrent * _row_bytes_estimate(schema)))
+        if fit < w:
+            _log_once(
+                f"clamp:{label}:{w}->{fit}",
+                "streaming: %s=%s holds only %d rows per window at "
+                "%d concurrent windows; clamping the %d-row window "
+                "to %d",
+                ENV_HOST_BUDGET,
+                os.environ.get(ENV_HOST_BUDGET, ""),
+                fit,
+                concurrent,
+                w,
+                fit,
+            )
+            w = fit
+    return w
+
+
+def _copy_path_detail(schema) -> str:
+    """Host-only / ragged columns in an Arrow schema, with reasons —
+    the streamed analog of ``cache()``'s skip log: these columns force
+    the host copy path every window (they can never stage to device)."""
+    import pyarrow as pa
+
+    forced = {}
+    for field in schema:
+        t = field.type
+        if (
+            pa.types.is_string(t)
+            or pa.types.is_large_string(t)
+            or pa.types.is_binary(t)
+            or pa.types.is_large_binary(t)
+        ):
+            forced[field.name] = "host-only (string/binary passthrough)"
+        elif pa.types.is_list(t) or pa.types.is_large_list(t):
+            forced[field.name] = (
+                "ragged (variable cell shapes; analyze/bucket per window)"
+            )
+    return "; ".join(f"{n}: {why}" for n, why in sorted(forced.items()))
+
+
+class StreamGroupedFrame:
+    """``stream.group_by(keys)`` result — the streaming analog of
+    :class:`~tensorframes_tpu.ops.engine.GroupedFrame`, consumed by
+    :func:`tensorframes_tpu.streaming.aggregate`."""
+
+    def __init__(self, stream: "StreamFrame", keys: Sequence[str]):
+        if not keys:
+            raise ValidationError("group_by needs at least one key column")
+        self.stream = stream
+        self.keys = list(keys)
+
+
+class StreamFrame:
+    """A windowed, out-of-core frame: iterate :meth:`windows` to get
+    consecutive bounded :class:`TensorFrame` views of the source.
+
+    Build one with :func:`scan_parquet` (files / part directories) or
+    :func:`from_batches` (any Arrow batch source).  The streaming verbs
+    (:mod:`tensorframes_tpu.streaming.verbs`) consume it; ``windows()``
+    is also a plain generator for custom loops.
+    """
+
+    def __init__(
+        self,
+        source: Callable[[], Iterator[Any]],
+        window_rows: Optional[int] = None,
+        num_blocks: Optional[int] = None,
+        columns: Optional[Sequence[str]] = None,
+        num_rows: Optional[int] = None,
+        reiterable: bool = False,
+        label: str = "stream",
+    ):
+        self._source = source
+        self._requested_rows = (
+            int(window_rows) if window_rows else window_rows_default()
+        )
+        if self._requested_rows < 1:
+            raise ValidationError(
+                f"window_rows must be >= 1, got {window_rows}"
+            )
+        self._num_blocks = int(num_blocks) if num_blocks else stream_blocks()
+        self._columns = list(columns) if columns else None
+        self.num_rows = num_rows  # None when the source is unbounded
+        self._reiterable = reiterable
+        self._label = label
+        self._consumed = False
+        self._spool_dir: Optional[str] = None
+        self._effective_rows: Optional[int] = None
+
+    # -- metadata ------------------------------------------------------------
+
+    @property
+    def window_rows(self) -> int:
+        """The effective window size — the requested/default rows, or
+        the ``TFS_HOST_BUDGET`` clamp once a pass has resolved it."""
+        return (
+            self._effective_rows
+            if self._effective_rows is not None
+            else self._requested_rows
+        )
+
+    def group_by(self, *keys: str) -> StreamGroupedFrame:
+        return StreamGroupedFrame(self, keys)
+
+    def __repr__(self):
+        rows = "?" if self.num_rows is None else self.num_rows
+        return (
+            f"StreamFrame[{self._label}: {rows} rows, "
+            f"window={self.window_rows}, blocks/window={self._num_blocks}]"
+        )
+
+    # -- windowing -----------------------------------------------------------
+
+    def _effective_window(self, schema) -> int:
+        return clamped_window(self._requested_rows, schema, self._label)
+
+    def _window_tables(self, chunks: Iterator[Any]) -> Iterator[Any]:
+        """Re-window a stream of Arrow record batches / tables into
+        consecutive tables of exactly ``window_rows`` rows (shorter
+        tail), holding at most one window + one source batch of rows
+        buffered."""
+        import pyarrow as pa
+
+        buf: List[Any] = []
+        buffered = 0
+        w: Optional[int] = None
+        names: Optional[List[str]] = None
+        for chunk in chunks:
+            tbl = (
+                chunk
+                if isinstance(chunk, pa.Table)
+                else pa.Table.from_batches([chunk])
+            )
+            if self._columns is not None:
+                tbl = tbl.select(self._columns)
+            if tbl.num_rows == 0:
+                continue
+            if names is None:
+                names = tbl.column_names
+            elif tbl.column_names != names:
+                # part files may order the same fields differently;
+                # concat_tables is order-sensitive, so align to the
+                # first chunk's layout (missing columns raise, rightly)
+                tbl = tbl.select(names)
+            if w is None:
+                w = self._effective_window(tbl.schema)
+                self._effective_rows = w
+                detail = _copy_path_detail(tbl.schema)
+                if detail:
+                    _log_once(
+                        "copy-path:" + detail,
+                        "streaming: source columns force the host copy "
+                        "path — %s. These columns stream through host "
+                        "RAM every window and never stage to device.",
+                        detail,
+                    )
+            buf.append(tbl)
+            buffered += tbl.num_rows
+            while buffered >= w:
+                whole = pa.concat_tables(buf) if len(buf) > 1 else buf[0]
+                yield whole.slice(0, w)
+                rest = whole.slice(w)
+                buf = [rest] if rest.num_rows else []
+                buffered -= w
+        if buffered:
+            yield pa.concat_tables(buf) if len(buf) > 1 else buf[0]
+
+    def _frame_from_table(self, tbl) -> TensorFrame:
+        from ..io import _column_from_arrow, _combined
+
+        cols = [
+            _column_from_arrow(name, _combined(tbl.column(name)))
+            for name in tbl.column_names
+        ]
+        frame = TensorFrame(cols).repartition(self._num_blocks)
+        # windowed frames have no durable host authority once the stream
+        # moves on: frame.cache() routes their budget evictions to the
+        # disk spill path (ops/frame_cache.py) instead of dropping
+        frame._host_windowed = True
+        return frame
+
+    def _iter_accounted(
+        self, stage_frame, num_items: Optional[int]
+    ) -> Iterator[TensorFrame]:
+        """The ONE accounted window-iteration loop, shared by the source
+        pass and the spool replay: ``stage_frame(i)`` (raising
+        ``StopIteration`` when dry) runs on a prefetch thread; each
+        window's host bytes enter the ``peak_host_bytes`` gauge when
+        staged and leave it when the consumer advances past the window.
+        Cleanup contract: stop the staging worker FIRST (its generator
+        finally reaps the thread), then release windows staged ahead but
+        never consumed (early exit, a failing verb) — otherwise a stage
+        still in flight could pin the live-bytes gauge forever."""
+        acct = {"acquired": 0, "released": 0}
+
+        def stage(i):
+            frame = stage_frame(i)
+            nbytes = frame_host_bytes(frame)
+            acct["acquired"] += nbytes
+            observability.note_stream_window()
+            observability.note_host_window_bytes(nbytes)
+            return frame, nbytes
+
+        pf = prefetch.Prefetcher(
+            stage, num_items, name="tfs-stream-window"
+        )
+        pf_iter = iter(pf)
+        try:
+            for frame, nbytes in pf_iter:
+                try:
+                    yield frame
+                finally:
+                    acct["released"] += nbytes
+                    observability.note_host_window_bytes(-nbytes)
+        finally:
+            pf_iter.close()
+            residual = acct["acquired"] - acct["released"]
+            if residual:
+                observability.note_host_window_bytes(-residual)
+
+    def windows(self) -> Iterator[TensorFrame]:
+        """Yield consecutive window frames.  Window k+1 is staged
+        (parquet decode + column build) on a prefetch thread while the
+        consumer processes window k; a window's host bytes are released
+        from the ``peak_host_bytes`` accounting when the consumer
+        advances past it."""
+        if self._spool_dir is not None:
+            yield from self._spooled_windows()
+            return
+        if self._consumed and not self._reiterable:
+            raise ValidationError(
+                f"StreamFrame[{self._label}]: the source is one-shot and "
+                f"was already consumed; set {_spill.ENV_SPILL_DIR} to "
+                f"spool windows to disk for re-iteration, or re-create "
+                f"the stream."
+            )
+        self._consumed = True
+        spool = (
+            _SpoolWriter(self._label)
+            if (not self._reiterable and _spill.configured())
+            else None
+        )
+        tables = self._window_tables(self._source())
+
+        def stage_frame(i):
+            tbl = next(tables)  # StopIteration ends the iteration
+            frame = self._frame_from_table(tbl)
+            if spool is not None:
+                spool.write(i, tbl)
+            return frame
+
+        completed = False
+        try:
+            yield from self._iter_accounted(stage_frame, None)
+            completed = True
+        finally:
+            if spool is not None:
+                if completed:
+                    self._spool_dir = spool.finish()
+                    # a stream dropped without exhausting its replays
+                    # must not leak its spool on disk (the same rule
+                    # FrameCache's finalizer applies to shard spills);
+                    # the callback holds the path, never self
+                    weakref.finalize(
+                        self, _remove_spool_dir, self._spool_dir
+                    )
+                else:
+                    spool.discard()
+
+    def _spooled_windows(self) -> Iterator[TensorFrame]:
+        """Replay pass over the spooled part files — one file per
+        original window, read (and counted) one window at a time."""
+        import pyarrow.parquet as pq
+
+        paths = [
+            os.path.join(self._spool_dir, n)
+            for n in sorted(os.listdir(self._spool_dir))
+            if n.endswith(".parquet")
+        ]
+
+        def stage_frame(i):
+            observability.note_spill_bytes_read(os.path.getsize(paths[i]))
+            return self._frame_from_table(pq.read_table(paths[i]))
+
+        yield from self._iter_accounted(stage_frame, len(paths))
+
+
+def _remove_spool_dir(path: str) -> None:
+    """GC finalizer body for a spooled StreamFrame: drop the spool."""
+    shutil.rmtree(path, ignore_errors=True)
+
+
+class _SpoolWriter:
+    """First-pass window spool: one parquet part file per window under
+    ``TFS_SPILL_DIR`` (each file closed — footer written — before the
+    consumer sees the window, so a spool interrupted mid-stream still
+    holds only complete windows)."""
+
+    def __init__(self, label: str):
+        root = _spill.spill_dir()
+        self.dir = os.path.join(
+            root, f"spool-{os.getpid()}-{label}-{id(self):x}"
+        )
+        os.makedirs(self.dir, exist_ok=True)
+        self._complete = False
+
+    def write(self, i: int, tbl) -> None:
+        import pyarrow.parquet as pq
+
+        path = os.path.join(self.dir, f"part-{i:06d}.parquet")
+        pq.write_table(tbl, path)
+        observability.note_spill_bytes_written(os.path.getsize(path))
+
+    def finish(self) -> str:
+        self._complete = True
+        return self.dir
+
+    def discard(self) -> None:
+        for n in os.listdir(self.dir):
+            try:
+                os.remove(os.path.join(self.dir, n))
+            except OSError:
+                pass
+        try:
+            os.rmdir(self.dir)
+        except OSError:
+            pass
+
+
+def scan_parquet(
+    path,
+    columns: Optional[Sequence[str]] = None,
+    window_rows: Optional[int] = None,
+    num_blocks: Optional[int] = None,
+) -> StreamFrame:
+    """Stream a parquet file — or a directory of part files, read in
+    sorted filename order — as a :class:`StreamFrame`, never holding
+    more than the prefetch window of ``window_rows``-row windows in host
+    RAM.  The out-of-core entry path: ``io.read_parquet`` materialises,
+    ``scan_parquet`` streams.
+
+    Row groups are iterated through ``pyarrow.parquet.ParquetFile.
+    iter_batches`` and re-windowed, so windows are independent of the
+    writer's row-group layout (a window may span row groups and part
+    files).  Parquet sources are re-iterable by re-scanning the files —
+    epoch loops need no spool."""
+    from ..io import _pyarrow, part_files
+
+    _pyarrow()  # consistent missing-dependency error surface
+    import pyarrow.parquet as pq
+
+    paths = part_files(path)
+    total = 0
+    for p in paths:
+        total += pq.ParquetFile(p).metadata.num_rows
+    cols = list(columns) if columns else None
+    hint = int(window_rows) if window_rows else window_rows_default()
+    # clamp the Arrow read granularity by the host budget up front, so
+    # even the pre-window batch buffer respects TFS_HOST_BUDGET
+    schema = pq.ParquetFile(paths[0]).schema_arrow
+    if cols:
+        import pyarrow as pa
+
+        schema = pa.schema([schema.field(c) for c in cols])
+    hint = clamped_window(hint, schema, os.path.basename(str(path)))
+
+    def source():
+        for p in paths:
+            pf = pq.ParquetFile(p)
+            yield from pf.iter_batches(
+                batch_size=hint, columns=cols
+            )
+
+    return StreamFrame(
+        source,
+        window_rows=window_rows,
+        num_blocks=num_blocks,
+        columns=None,  # pushed down to iter_batches above
+        num_rows=total,
+        reiterable=True,
+        label=os.path.basename(str(path)) or "parquet",
+    )
+
+
+def from_batches(
+    batches: Any,
+    window_rows: Optional[int] = None,
+    num_blocks: Optional[int] = None,
+    columns: Optional[Sequence[str]] = None,
+    label: str = "batches",
+) -> StreamFrame:
+    """Stream an arbitrary source of Arrow record batches / tables —
+    a callable returning an iterator (re-iterable: a fresh iterator per
+    pass), or a plain iterable (one-shot: a second pass needs
+    ``TFS_SPILL_DIR``, which spools windows to disk on the first).
+    This is the unbounded-ingestion entry: the source may never end, and
+    the stream still runs at fixed host memory."""
+    if callable(batches):
+        return StreamFrame(
+            batches,
+            window_rows=window_rows,
+            num_blocks=num_blocks,
+            columns=columns,
+            reiterable=True,
+            label=label,
+        )
+    it = iter(batches)
+    return StreamFrame(
+        lambda: it,
+        window_rows=window_rows,
+        num_blocks=num_blocks,
+        columns=columns,
+        reiterable=False,
+        label=label,
+    )

@@ -1,0 +1,124 @@
+"""Streaming output sinks: where fixed-memory map verbs put their rows.
+
+A streamed map produces one output frame per window; holding them all
+would defeat the fixed-memory contract, so the verbs hand each window to
+a *sink* the moment it completes and drop the reference:
+
+* :class:`ParquetSink` — appends each window to one parquet file (one
+  row-group batch per window by default, or re-chunked by
+  ``row_group_size``).  **Window-boundary durability**: ``write``
+  returns only after the window's bytes are handed to the writer, and
+  ``close()`` — which the verbs run on success, cancellation, and error
+  alike — finalises the footer over exactly the windows written, so a
+  mid-stream cancellation leaves a readable file ending at a window
+  boundary, never a torn window (docs/RESILIENCE.md).
+* :class:`CollectSink` — accumulates windows in host RAM and assembles
+  one TensorFrame whose blocks are the stream's windows (tests, small
+  results).  Deliberately NOT fixed-memory; ``limit_rows`` guards
+  against accidentally collecting an unbounded stream.
+* ``sink=None`` on the verbs returns a lazy iterator of output window
+  frames instead — the bounded in-memory form (one window live at a
+  time, pulled by the consumer).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..frame import TensorFrame
+from ..ops.validation import ValidationError
+
+
+class ParquetSink:
+    """Append streamed output windows to one parquet file."""
+
+    def __init__(self, path, row_group_size: Optional[int] = None):
+        self.path = str(path)
+        self.row_group_size = row_group_size
+        self.rows = 0
+        self.windows = 0
+        self._writer = None
+        self._closed = False
+
+    def write(self, frame: TensorFrame) -> None:
+        if self._closed:
+            raise ValidationError(
+                f"ParquetSink({self.path!r}): write after close"
+            )
+        from ..io import frame_to_table
+        import pyarrow.parquet as pq
+
+        table = frame_to_table(frame)
+        if self._writer is None:
+            self._writer = pq.ParquetWriter(self.path, table.schema)
+        self._writer.write_table(table, row_group_size=self.row_group_size)
+        self.rows += table.num_rows
+        self.windows += 1
+
+    def close(self) -> Dict[str, Any]:
+        """Finalise the file (idempotent) and return the summary the
+        verbs hand back: path, rows, windows, on-disk bytes."""
+        if not self._closed:
+            self._closed = True
+            if self._writer is not None:
+                self._writer.close()
+        return self.result()
+
+    def result(self) -> Dict[str, Any]:
+        """Summary dict.  ``path`` is None when NO window was ever
+        written: the writer is schema-lazy (the schema comes from the
+        first output window), so a zero-window stream leaves no file on
+        disk — a None path says so, instead of pointing a downstream
+        reader at a file that does not exist."""
+        nbytes = 0
+        if self._writer is not None and os.path.exists(self.path):
+            nbytes = os.path.getsize(self.path)
+        return {
+            "path": self.path if self._writer is not None else None,
+            "rows": self.rows,
+            "windows": self.windows,
+            "bytes": nbytes,
+        }
+
+
+class CollectSink:
+    """Accumulate output windows and assemble one TensorFrame whose
+    block boundaries are the stream's window boundaries (so the result
+    compares directly against a materialized run with the same
+    offsets)."""
+
+    def __init__(self, limit_rows: Optional[int] = None):
+        self.limit_rows = limit_rows
+        self.rows = 0
+        self.windows = 0
+        self._blocks: List[Dict[str, Any]] = []
+
+    def write(self, frame: TensorFrame) -> None:
+        for bi in range(frame.num_blocks):
+            # materialise now: the block dict may hold device arrays or
+            # views into the window's host columns; copying releases the
+            # window (and its passthrough inputs) for reuse
+            block = {
+                name: np.asarray(v)
+                for name, v in frame.block(bi).items()
+            }
+            self._blocks.append(block)
+        self.rows += frame.num_rows
+        self.windows += 1
+        if self.limit_rows is not None and self.rows > self.limit_rows:
+            raise ValidationError(
+                f"CollectSink: collected {self.rows} rows, over the "
+                f"limit_rows={self.limit_rows} guard — this stream is "
+                f"bigger than an in-memory collect; use a ParquetSink."
+            )
+
+    def close(self) -> Optional[TensorFrame]:
+        return self.result()
+
+    def result(self) -> Optional[TensorFrame]:
+        if not self._blocks:
+            return None
+        return TensorFrame.from_blocks(self._blocks)

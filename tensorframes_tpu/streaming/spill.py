@@ -1,0 +1,117 @@
+"""Disk spill store for out-of-core frames (``TFS_SPILL_DIR``).
+
+Two producers write here, both with the same contract — *bytes that have
+no other durable home go to local disk, counted*:
+
+* the budget LRU's eviction path (``ops/frame_cache.py``): a sharded
+  cache built over a **windowed** frame has no authoritative host copy
+  to fall back to (the stream has moved past the window), so eviction
+  writes the shard's bytes to a spill file and ``shard()`` restores them
+  on next use;
+* the windowed reader (``streaming/reader.py``): a non-re-iterable
+  source (an unbounded Arrow batch iterator, a one-shot generator) is
+  spooled window-by-window to parquet part files on its first pass, so a
+  second pass — the kmeans-style epoch loop, or a reduce after a map —
+  replays from local disk instead of being impossible.
+
+Shard spill files are ``.npz`` (numeric column dicts — exactly what a
+device shard holds); window spools are parquet (full column fidelity,
+and a spool directory IS a valid ``scan_parquet`` source).  Traffic is
+counted in ``observability.counters()``: ``spill_bytes_written`` /
+``spill_bytes_read``.
+
+Knob: ``TFS_SPILL_DIR`` — spill root directory (created on demand;
+empty/unset disables spill: evictions drop, one-shot sources are
+single-pass).
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import observability
+
+logger = logging.getLogger("tensorframes_tpu.streaming")
+
+ENV_SPILL_DIR = "TFS_SPILL_DIR"
+
+
+def spill_dir() -> str:
+    """The configured spill root (``TFS_SPILL_DIR``; "" = disabled)."""
+    return os.environ.get(ENV_SPILL_DIR, "").strip()
+
+
+def configured() -> bool:
+    return bool(spill_dir())
+
+
+def store_if_configured() -> Optional["SpillStore"]:
+    """A :class:`SpillStore` rooted at ``TFS_SPILL_DIR``, or None when
+    spill is disabled."""
+    d = spill_dir()
+    return SpillStore(d) if d else None
+
+
+class SpillStore:
+    """Keyed dict-of-ndarray persistence under one directory.
+
+    ``put`` serialises to ``<key>.npz`` via an in-memory buffer (one
+    write syscall per shard; the byte count the counter records is the
+    true on-disk size, compression-free so restore stays a read+copy).
+    Keys are caller-namespaced (``shard-<pid>-<id>-<bi>``) so several
+    caches can share one directory.
+
+    Concurrency: no lock, by design.  ``put`` writes to a temp file and
+    ``os.replace``s it into place, so a racing ``get`` of the same key
+    sees either the complete old file or the complete new one, never a
+    torn write; ``get``/``delete`` tolerate a missing file.  Shard
+    contents are immutable (a key is only ever re-put with identical
+    bytes), so every interleaving of put/get/delete yields either the
+    valid payload or a clean miss — the callers (the budget LRU's
+    outside-lock eviction hooks, ``FrameCache.shard`` restores) handle
+    a miss by falling back."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in key)
+        return os.path.join(self.root, safe + ".npz")
+
+    def put(self, key: str, arrays: Dict[str, np.ndarray]) -> int:
+        """Persist ``arrays`` under ``key``; returns (and counts) the
+        bytes written."""
+        buf = io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+        data = buf.getvalue()
+        path = self._path(key)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic: a reader never sees a torn file
+        observability.note_spill_bytes_written(len(data))
+        return len(data)
+
+    def get(self, key: str) -> Optional[Dict[str, np.ndarray]]:
+        """Restore ``key``'s arrays (counted), or None when absent."""
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return None
+        observability.note_spill_bytes_read(len(data))
+        with np.load(io.BytesIO(data)) as z:
+            return {k: z[k] for k in z.files}
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,377 @@
+"""Fixed-memory streaming verbs: the six-verb surface over a
+:class:`~tensorframes_tpu.streaming.reader.StreamFrame`.
+
+Each window is an ordinary :class:`TensorFrame`, so every window runs
+through the UNMODIFIED engine — prefetch lanes, bucketing (full windows
+share one row count, hence one hot executable), device pool, per-block
+fault tolerance, and cancellation checkpoints all apply per window.
+What this module adds is the cross-window composition:
+
+* **map verbs** stream window -> device -> sink: with ``sink=None`` they
+  return a lazy iterator of output window frames (one window live at a
+  time); with ``sink=`` a path or sink object they write each window as
+  it completes and return the sink summary.  The sink is closed on
+  success, cancellation, and error alike, so a mid-stream cancellation
+  leaves it at a window boundary (docs/RESILIENCE.md).
+* **reduce verbs** run as incremental monoid folds: each window
+  contributes its per-block partials through the engine's own
+  ``_reduce_partials`` (device-resident, one cell per base column per
+  block), and the final combine is the engine's ``_combine_partials`` —
+  the EXACT fold shape of the materialized verbs, so a windowed reduce
+  is bit-identical to the materialized reduce over a frame with the same
+  block boundaries.
+* **aggregate** folds per-window grouped partials: window k's aggregate
+  output (keys + reduced cells) merges into the running result by
+  re-applying the same program over the concatenated partial rows — the
+  init-then-merge contract ``aggregate`` already requires of its
+  programs (the reference UDAF merges partial buffers the same way,
+  ``DebugRowOps.scala:658-676``).  Exact monoids (sum/min/max over
+  integers, or floats whose sums round exactly) are bit-identical to the
+  materialized aggregate; inexact float sums may differ in the last ulp,
+  exactly as the materialized engine's own bucketed-vs-tree strategies
+  may.
+
+Every verb records a ``stream_<verb>`` span annotated with ``streaming``
+(windows, rows, live/peak host bytes) on top of the per-window verb
+spans the engine already emits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+from .. import cancellation, observability
+from ..frame import Column, TensorFrame
+from ..ops.engine import GroupedFrame, _np, _resolve, _wrap
+from ..ops.validation import ValidationError
+import logging
+
+from .reader import StreamFrame, StreamGroupedFrame
+from .sink import ParquetSink
+
+logger = logging.getLogger("tensorframes_tpu.streaming")
+
+
+def _as_sink(sink):
+    if isinstance(sink, (str, bytes)) or hasattr(sink, "__fspath__"):
+        return ParquetSink(sink)
+    return sink
+
+
+class _MergingSpan:
+    """Span adapter for the streamed reduce verbs: the engine annotates
+    the SAME span once per window (``fault_tolerance``, ``device_pool``,
+    ``frame_cache``), and a plain span's ``annotate`` overwrites — the
+    last window would silently erase every earlier window's retry /
+    quarantine evidence.  This adapter SUMS numeric fields across
+    windows (non-numeric fields keep last-wins) so the stream span
+    carries whole-stream totals."""
+
+    def __init__(self, span):
+        self._span = span
+        self._acc = {}
+
+    def mark(self, phase: str) -> None:
+        self._span.mark(phase)
+
+    def annotate(self, key: str, value) -> None:
+        if isinstance(value, dict):
+            acc = self._acc.setdefault(key, {})
+            for k, v in value.items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    acc[k] = v
+                else:
+                    acc[k] = acc.get(k, 0) + v
+            value = dict(acc)
+        self._span.annotate(key, value)
+
+
+def _annotate(span, stream: StreamFrame, windows: int, rows: int) -> None:
+    span.annotate(
+        "streaming",
+        {
+            "windows": windows,
+            "rows": rows,
+            "window_rows": stream.window_rows,
+            "live_host_bytes": observability.live_host_bytes(),
+            "peak_host_bytes": observability.counters()["peak_host_bytes"],
+        },
+    )
+
+
+def _drain_to_sink(outputs, sink, span_name: str, stream: StreamFrame):
+    """The ONE sink-drain loop of the streamed map/pipeline verbs:
+    write each output window as it completes, and close the sink on
+    success, cancellation, and error alike — the window-boundary
+    durability contract (docs/RESILIENCE.md) lives here and nowhere
+    else."""
+    sink = _as_sink(sink)
+    with observability.verb_span(span_name, 0, 0) as span:
+        windows = rows = 0
+        try:
+            for out in outputs:
+                sink.write(out)
+                windows += 1
+                rows += out.num_rows
+                del out
+        except BaseException:
+            # close on cancellation/error too — the sink finalises over
+            # exactly the complete windows written — but NEVER let a
+            # failing close replace the primary error: a DeadlineExceeded
+            # must surface as a deadline, not as the disk-full OSError
+            # the footer write hit on the way down
+            try:
+                sink.close()
+            except Exception:
+                logger.warning(
+                    "%s: sink close failed while handling an earlier "
+                    "error; the primary error follows",
+                    span_name,
+                    exc_info=True,
+                )
+            _annotate(span, stream, windows, rows)
+            raise
+        result = sink.close()
+        _annotate(span, stream, windows, rows)
+        return result
+
+
+def _map_stream(
+    program,
+    stream: StreamFrame,
+    rows_level: bool,
+    trim: bool,
+    host_stage,
+    sink,
+    engine,
+):
+    ex = _resolve(engine)
+
+    def window_outputs() -> Iterator[TensorFrame]:
+        for wf in stream.windows():
+            # window boundary = cancellation checkpoint: a deadline that
+            # passes mid-stream stops BEFORE the next window dispatches,
+            # leaving the sink at a window boundary
+            cancellation.checkpoint()
+            if rows_level:
+                yield ex.map_rows(program, wf, host_stage=host_stage)
+            else:
+                yield ex.map_blocks(
+                    program, wf, trim=trim, host_stage=host_stage
+                )
+
+    if sink is None:
+        # bounded in-memory form: a lazy iterator, one output window
+        # live at a time, pulled at the consumer's pace
+        return window_outputs()
+    verb = "map_rows" if rows_level else (
+        "map_blocks_trimmed" if trim else "map_blocks"
+    )
+    return _drain_to_sink(window_outputs(), sink, f"stream_{verb}", stream)
+
+
+def map_blocks(
+    fn,
+    stream: StreamFrame,
+    trim: bool = False,
+    fetches: Optional[Sequence[str]] = None,
+    feed_dict: Optional[Mapping[str, str]] = None,
+    host_stage: Optional[Mapping[str, Any]] = None,
+    shapes: Optional[Mapping[str, Sequence[int]]] = None,
+    sink=None,
+    engine=None,
+):
+    """Streamed ``tfs.map_blocks``: apply the block program to every
+    window's blocks at fixed host memory.  Returns an iterator of output
+    window frames (``sink=None``) or the sink's summary."""
+    program = _wrap(fn, fetches, feed_dict, shapes)
+    return _map_stream(
+        program, stream, False, trim, host_stage, sink, engine
+    )
+
+
+def map_blocks_trimmed(fn, stream: StreamFrame, **kw):
+    """Streamed ``tfs.map_blocks_trimmed`` (output row count per window
+    is program-defined)."""
+    return map_blocks(fn, stream, trim=True, **kw)
+
+
+def map_rows(
+    fn,
+    stream: StreamFrame,
+    fetches: Optional[Sequence[str]] = None,
+    feed_dict: Optional[Mapping[str, str]] = None,
+    host_stage: Optional[Mapping[str, Any]] = None,
+    shapes: Optional[Mapping[str, Sequence[int]]] = None,
+    sink=None,
+    engine=None,
+):
+    """Streamed ``tfs.map_rows``: the cell program vmapped over every
+    window at fixed host memory."""
+    program = _wrap(fn, fetches, feed_dict, shapes)
+    return _map_stream(
+        program, stream, True, False, host_stage, sink, engine
+    )
+
+
+def _reduce_stream(program, stream: StreamFrame, mode, engine, verb: str):
+    """Shared incremental fold of the two reduce verbs: per-window
+    partials through the engine's ``_reduce_partials``, one final
+    ``_combine_partials`` across everything — the materialized fold
+    shape, window boundaries and all.
+
+    State growth, precisely: HOST memory stays fixed (one window live),
+    but the partial list grows by one reduced CELL per base column per
+    block seen — bytes per window, not rows — and the final combine
+    stacks them all once.  That is the price of exact bit-identity with
+    the materialized fold shape; it bounds practical streams (a million
+    windows of one f64 cell ≈ 8 MB) but not a truly endless one.  For
+    never-ending sources, chunk the stream and re-reduce the chunk
+    results, or use :func:`aggregate`, which folds eagerly and holds
+    O(groups) state regardless of stream length."""
+    ex = _resolve(engine)
+    with observability.verb_span(f"stream_{verb}", 0, 0) as span:
+        merged = _MergingSpan(span)  # per-window annotations accumulate
+        setup = None
+        partials = []
+        windows = rows = 0
+        for wf in stream.windows():
+            cancellation.checkpoint()
+            if setup is None:
+                setup = (
+                    ex._reduce_rows_setup(program, wf, mode)
+                    if verb == "reduce_rows"
+                    else ex._reduce_blocks_setup(program, wf)
+                )
+            bases, reduced, run = setup
+            partials.extend(
+                ex._reduce_partials(run, bases, reduced, wf, merged)
+            )
+            windows += 1
+            rows += wf.num_rows
+        if setup is None:
+            raise ValidationError(
+                f"stream_{verb}: cannot reduce an empty stream (no "
+                f"identity element is available for an arbitrary program)"
+            )
+        final = ex._combine_partials(run, bases, partials)
+        _annotate(span, stream, windows, rows)
+        return {b: _np(final[b]) for b in bases}
+
+
+def reduce_rows(
+    fn,
+    stream: StreamFrame,
+    fetches: Optional[Sequence[str]] = None,
+    mode: str = "tree",
+    shapes: Optional[Mapping[str, Sequence[int]]] = None,
+    engine=None,
+) -> Dict[str, np.ndarray]:
+    """Streamed ``tfs.reduce_rows``: pairwise-fold every row of an
+    out-of-core stream down to one cell per column, holding one window
+    at a time plus one reduced cell per block seen (state grows with
+    window COUNT, not rows — see ``_reduce_stream``)."""
+    program = _wrap(fn, fetches, shapes=shapes)
+    return _reduce_stream(program, stream, mode, engine, "reduce_rows")
+
+
+def reduce_blocks(
+    fn,
+    stream: StreamFrame,
+    fetches: Optional[Sequence[str]] = None,
+    shapes: Optional[Mapping[str, Sequence[int]]] = None,
+    engine=None,
+) -> Dict[str, np.ndarray]:
+    """Streamed ``tfs.reduce_blocks``: per-block reduce as windows
+    arrive, one re-application of the block program to the stacked
+    partials at the end."""
+    program = _wrap(fn, fetches, shapes=shapes)
+    return _reduce_stream(program, stream, None, engine, "reduce_blocks")
+
+
+def _concat_partial_frames(a: TensorFrame, b: TensorFrame) -> TensorFrame:
+    """Row-concat two aggregate partial frames (same columns by
+    construction: keys ++ bases, uniform cells)."""
+    cols = []
+    for ca in a.columns:
+        cb = b.column(ca.info.name)
+        data = np.concatenate([np.asarray(ca.data), np.asarray(cb.data)])
+        cols.append(Column(ca.info, data))
+    return TensorFrame(cols)
+
+
+def aggregate(
+    fn,
+    grouped: StreamGroupedFrame,
+    fetches: Optional[Sequence[str]] = None,
+    shapes: Optional[Mapping[str, Sequence[int]]] = None,
+    engine=None,
+) -> TensorFrame:
+    """Streamed ``tfs.aggregate``: keyed algebraic aggregation over an
+    out-of-core stream at fixed memory — host RAM holds one window plus
+    one partial row per distinct key seen so far.
+
+    Per window the engine's own ``aggregate`` runs (segment fast path
+    included); the running result merges each window's partials by
+    re-applying the same program over the concatenated partial rows,
+    which is legal for exactly the algebraic, re-applicable programs
+    ``aggregate`` already requires (``Operations.scala:110-126``)."""
+    if not isinstance(grouped, StreamGroupedFrame):
+        raise ValidationError(
+            "streaming.aggregate takes stream.group_by(...); for a "
+            "materialized frame use tfs.aggregate"
+        )
+    program = _wrap(fn, fetches, shapes=shapes)
+    ex = _resolve(engine)
+    stream, keys = grouped.stream, grouped.keys
+    with observability.verb_span("stream_aggregate", 0, 0) as span:
+        acc: Optional[TensorFrame] = None
+        windows = rows = 0
+        for wf in stream.windows():
+            cancellation.checkpoint()
+            part = ex.aggregate(program, GroupedFrame(wf, keys))
+            acc = (
+                part
+                if acc is None
+                else ex.aggregate(
+                    program,
+                    GroupedFrame(_concat_partial_frames(acc, part), keys),
+                )
+            )
+            windows += 1
+            rows += wf.num_rows
+        if acc is None:
+            raise ValidationError(
+                "stream_aggregate: cannot aggregate an empty stream"
+            )
+        _annotate(span, stream, windows, rows)
+        return acc
+
+
+def run_pipeline(
+    pipe,
+    stream: StreamFrame,
+    sink=None,
+) -> Union[Iterator[TensorFrame], Any]:
+    """Run a frame-terminal :class:`~tensorframes_tpu.ops.pipeline.
+    Pipeline` chain over every window (``Pipeline.with_frame`` re-binds
+    the chain; the stages' Programs — and their hot executables — are
+    shared across windows).  Row-terminal chains (reduce/then) have no
+    per-window meaning; use the streaming reduce verbs."""
+    if getattr(pipe, "_row_stage", False):
+        raise ValidationError(
+            "streaming.run_pipeline: the chain ends in a row-producing "
+            "stage; stream the map stages and use streaming.reduce_* "
+            "for the fold."
+        )
+
+    def window_outputs():
+        for wf in stream.windows():
+            cancellation.checkpoint()
+            yield pipe.with_frame(wf).run()
+
+    if sink is None:
+        return window_outputs()
+    return _drain_to_sink(window_outputs(), sink, "stream_pipeline", stream)

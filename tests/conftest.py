@@ -52,6 +52,20 @@ os.environ.setdefault("TFS_BRIDGE_MAX_FRAMES", "0")
 os.environ.setdefault("TFS_BRIDGE_CLIENT_TIMEOUT_S", "")
 os.environ.setdefault("TFS_BRIDGE_CLIENT_RETRIES", "3")
 
+# Out-of-core streaming (round 12, tensorframes_tpu/streaming/) stays at
+# its inert defaults in the main suite: no spill dir (evictions drop to
+# the authoritative host copy as rounds 10-11 pinned), no host-budget
+# window clamp, default window size.  The streaming tests set their own
+# knobs via monkeypatch/tmp_path; run_tests.sh's streaming tier re-runs
+# them with the env knobs live.  Like every TFS_* default above these
+# are absence-defaults (setdefault), not hard pins: an explicitly
+# exported TFS_SPILL_DIR/TFS_HOST_BUDGET — e.g. the streaming tier, or
+# a developer reproducing a spill-path failure — deliberately wins.
+os.environ.setdefault("TFS_SPILL_DIR", "")
+os.environ.setdefault("TFS_HOST_BUDGET", "")
+os.environ.setdefault("TFS_STREAM_WINDOW", "")
+os.environ.setdefault("TFS_STREAM_BLOCKS", "")
+
 import jax  # noqa: E402
 
 # The axon environment's sitecustomize force-registers the TPU backend and

@@ -1,0 +1,562 @@
+"""Out-of-core streaming frames (``tensorframes_tpu/streaming/``).
+
+Pins the round-12 contracts:
+
+* windowed parquet ingestion partitions rows deterministically
+  (``TFS_STREAM_WINDOW`` windows, shorter tail), across row-group and
+  part-file boundaries;
+* all six streamed verbs are bit-identical to the materialized verbs
+  over a frame with the SAME block boundaries — including the uneven
+  tail window, and under deterministic fault injection;
+* fixed memory: ``peak_host_bytes`` stays bounded by a few windows while
+  the stream covers a much larger frame; ``TFS_HOST_BUDGET`` clamps the
+  window;
+* disk spill: ``SpillStore`` roundtrip, budget-evicted shards of
+  windowed frames spill to ``TFS_SPILL_DIR`` and restore, one-shot
+  sources spool for re-iteration;
+* mid-stream cancellation leaves a parquet sink at a window boundary.
+"""
+
+import logging
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import cancellation, observability as obs, streaming
+from tensorframes_tpu.frame import TensorFrame
+from tensorframes_tpu.ops.validation import ValidationError
+from tensorframes_tpu.streaming import spill as spill_mod
+
+import jax.numpy as jnp
+
+
+N_ROWS = 1000
+WINDOW = 300  # uneven tail: 300/300/300/100
+
+
+@pytest.fixture()
+def pq_path(tmp_path):
+    rng = np.random.RandomState(7)
+    frame = tfs.TensorFrame.from_arrays(
+        {
+            # float64 values that are small integers: sums are EXACT in
+            # any association, so aggregate bit-identity is meaningful
+            "x": rng.randint(0, 16, (N_ROWS, 4)).astype(np.float64),
+            "k": rng.randint(0, 5, N_ROWS).astype(np.int32),
+        }
+    )
+    path = tmp_path / "t.parquet"
+    # row groups deliberately misaligned with the window size
+    frame.to_parquet(path, row_group_size=128)
+    return str(path)
+
+
+def _windowed_reference(path):
+    """The materialized frame with block boundaries = stream windows —
+    the bit-identity comparison target for every streamed verb."""
+    full = tfs.TensorFrame.from_parquet(path)
+    offsets = list(range(0, full.num_rows, WINDOW)) + [full.num_rows]
+    return TensorFrame(list(full.columns), offsets)
+
+
+def _scan(path, **kw):
+    kw.setdefault("window_rows", WINDOW)
+    return streaming.scan_parquet(path, **kw)
+
+
+# ---------------------------------------------------------------------------
+# windowing
+# ---------------------------------------------------------------------------
+
+
+def test_scan_parquet_window_partition(pq_path):
+    st = _scan(pq_path)
+    assert st.num_rows == N_ROWS
+    frames = list(st.windows())
+    assert [f.num_rows for f in frames] == [300, 300, 300, 100]
+    # rows arrive in file order, across the misaligned row groups
+    ref = tfs.TensorFrame.from_parquet(pq_path)
+    got = np.concatenate([np.asarray(f.column("x").data) for f in frames])
+    np.testing.assert_array_equal(got, np.asarray(ref.column("x").data))
+    # parquet sources re-iterate without a spool
+    assert [f.num_rows for f in st.windows()] == [300, 300, 300, 100]
+
+
+def test_scan_parquet_directory_of_parts(tmp_path):
+    d = tmp_path / "parts"
+    d.mkdir()
+    for i in range(3):
+        tfs.TensorFrame.from_arrays(
+            {"x": np.arange(i * 10, i * 10 + 10, dtype=np.float64)}
+        ).to_parquet(d / f"part-{i:03d}.parquet")
+    # materialized read: sorted part order
+    full = tfs.TensorFrame.from_parquet(str(d))
+    np.testing.assert_array_equal(
+        np.asarray(full.column("x").data), np.arange(30, dtype=np.float64)
+    )
+    # streamed scan: same order, windows spanning part files
+    st = streaming.scan_parquet(str(d), window_rows=12)
+    got = np.concatenate(
+        [np.asarray(f.column("x").data) for f in st.windows()]
+    )
+    np.testing.assert_array_equal(got, np.arange(30, dtype=np.float64))
+
+
+def test_stream_windows_counter(pq_path):
+    before = obs.counters()["stream_windows"]
+    list(_scan(pq_path).windows())
+    assert obs.counters()["stream_windows"] - before == 4
+
+
+# ---------------------------------------------------------------------------
+# six-verb bit-identity (windowed vs materialized, uneven tail included)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_map_blocks_bit_identity(pq_path):
+    ref = tfs.map_blocks(
+        lambda x: {"z": jnp.tanh(x) * 2.0}, _windowed_reference(pq_path)
+    )
+    got = streaming.map_blocks(
+        lambda x: {"z": jnp.tanh(x) * 2.0},
+        _scan(pq_path),
+        sink=streaming.CollectSink(),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.column("z").data), np.asarray(ref.column("z").data)
+    )
+    # passthrough columns survive the sink
+    np.testing.assert_array_equal(
+        np.asarray(got.column("k").data),
+        np.asarray(ref.column("k").data),
+    )
+
+
+def test_stream_map_rows_bit_identity(pq_path):
+    fn = lambda x: {"y": (x * x).sum()}  # noqa: E731
+    ref = tfs.map_rows(fn, _windowed_reference(pq_path))
+    got = streaming.map_rows(
+        fn, _scan(pq_path), sink=streaming.CollectSink()
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.column("y").data), np.asarray(ref.column("y").data)
+    )
+
+
+def test_stream_map_blocks_trimmed_bit_identity(pq_path):
+    fn = lambda x: {"s": x.sum(0, keepdims=True)}  # noqa: E731
+    ref = tfs.map_blocks_trimmed(fn, _windowed_reference(pq_path))
+    got = streaming.map_blocks_trimmed(
+        fn, _scan(pq_path), sink=streaming.CollectSink()
+    )
+    # one summary row per block = per window
+    assert got.num_rows == ref.num_rows == 4
+    np.testing.assert_array_equal(
+        np.asarray(got.column("s").data), np.asarray(ref.column("s").data)
+    )
+
+
+@pytest.mark.parametrize("mode", ["tree", "sequential"])
+def test_stream_reduce_rows_bit_identity(pq_path, mode):
+    fn = lambda x_1, x_2: {"x": x_1 + x_2}  # noqa: E731
+    ref = tfs.reduce_rows(fn, _windowed_reference(pq_path), mode=mode)
+    got = streaming.reduce_rows(fn, _scan(pq_path), mode=mode)
+    np.testing.assert_array_equal(got["x"], ref["x"])
+
+
+def test_stream_reduce_blocks_bit_identity(pq_path):
+    fn = lambda x_input: {"x": x_input.sum(0)}  # noqa: E731
+    ref = tfs.reduce_blocks(fn, _windowed_reference(pq_path))
+    got = streaming.reduce_blocks(fn, _scan(pq_path))
+    np.testing.assert_array_equal(got["x"], ref["x"])
+
+
+def test_stream_aggregate_bit_identity(pq_path):
+    fn = lambda x_input: {"x": x_input.sum(0)}  # noqa: E731
+    ref = tfs.aggregate(
+        fn, tfs.group_by(tfs.TensorFrame.from_parquet(pq_path), "k")
+    )
+    got = streaming.aggregate(fn, _scan(pq_path).group_by("k"))
+    np.testing.assert_array_equal(
+        np.asarray(got.column("k").data), np.asarray(ref.column("k").data)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.column("x").data), np.asarray(ref.column("x").data)
+    )
+
+
+def test_stream_verbs_bit_identity_under_chaos(pq_path, monkeypatch):
+    """All six streamed verbs recover to bit-identical results when a
+    transient fault fires on attempt 0 of every window's first block
+    (the fault-tolerance layer applies per window)."""
+    ref = _windowed_reference(pq_path)
+    mb = lambda x: {"z": x * 3.0}  # noqa: E731
+    mr = lambda x: {"y": (x * x).sum()}  # noqa: E731
+    mt = lambda x: {"s": x.sum(0, keepdims=True)}  # noqa: E731
+    rr = lambda x_1, x_2: {"x": x_1 + x_2}  # noqa: E731
+    rb = lambda x_input: {"x": x_input.sum(0)}  # noqa: E731
+    refs = {
+        "map_blocks": tfs.map_blocks(mb, ref),
+        "map_rows": tfs.map_rows(mr, ref),
+        "trimmed": tfs.map_blocks_trimmed(mt, ref),
+        "reduce_rows": tfs.reduce_rows(rr, ref),
+        "reduce_blocks": tfs.reduce_blocks(rb, ref),
+        "agg": tfs.aggregate(
+            rb, tfs.group_by(tfs.TensorFrame.from_parquet(pq_path), "k")
+        ),
+    }
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", "2")
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:block=0:attempt=0")
+    before = obs.counters()["faults_injected"]
+    for name, got in (
+        (
+            "map_blocks",
+            streaming.map_blocks(
+                mb, _scan(pq_path), sink=streaming.CollectSink()
+            ),
+        ),
+        (
+            "map_rows",
+            streaming.map_rows(
+                mr, _scan(pq_path), sink=streaming.CollectSink()
+            ),
+        ),
+        (
+            "trimmed",
+            streaming.map_blocks_trimmed(
+                mt, _scan(pq_path), sink=streaming.CollectSink()
+            ),
+        ),
+    ):
+        out_col = {"map_blocks": "z", "map_rows": "y", "trimmed": "s"}[name]
+        np.testing.assert_array_equal(
+            np.asarray(got.column(out_col).data),
+            np.asarray(refs[name].column(out_col).data),
+            err_msg=name,
+        )
+    np.testing.assert_array_equal(
+        streaming.reduce_rows(rr, _scan(pq_path))["x"],
+        refs["reduce_rows"]["x"],
+    )
+    np.testing.assert_array_equal(
+        streaming.reduce_blocks(rb, _scan(pq_path))["x"],
+        refs["reduce_blocks"]["x"],
+    )
+    got_agg = streaming.aggregate(rb, _scan(pq_path).group_by("k"))
+    np.testing.assert_array_equal(
+        np.asarray(got_agg.column("x").data),
+        np.asarray(refs["agg"].column("x").data),
+    )
+    assert obs.counters()["faults_injected"] > before  # chaos really ran
+
+
+# ---------------------------------------------------------------------------
+# fixed memory
+# ---------------------------------------------------------------------------
+
+
+def test_peak_host_bytes_bounded(tmp_path):
+    """The high-water host gauge stays at a few windows while the
+    stream covers the whole (much larger) frame."""
+    rows, dim = 8192, 8
+    path = tmp_path / "big.parquet"
+    tfs.TensorFrame.from_arrays(
+        {"x": np.random.RandomState(0).rand(rows, dim)}
+    ).to_parquet(path, row_group_size=1024)
+    window = 512
+    window_bytes = window * dim * 8
+    frame_bytes = rows * dim * 8
+    obs.reset_peak_host_bytes()
+    total = 0
+    for w in streaming.scan_parquet(str(path), window_rows=window).windows():
+        total += w.num_rows
+    assert total == rows
+    peak = obs.counters()["peak_host_bytes"]
+    assert peak >= window_bytes  # at least one window was accounted
+    # bounded by the prefetch window of windows, far under the frame
+    from tensorframes_tpu.ops.prefetch import prefetch_depth
+
+    assert peak <= (prefetch_depth() + 2) * window_bytes
+    assert peak < frame_bytes / 2
+    # consumed windows were released: the live gauge returns to rest
+    assert obs.live_host_bytes() == 0
+
+
+def test_host_budget_clamps_window(tmp_path, monkeypatch):
+    rows = 4096
+    path = tmp_path / "b.parquet"
+    tfs.TensorFrame.from_arrays(
+        {"x": np.zeros((rows, 8), np.float64)}
+    ).to_parquet(path)
+    monkeypatch.setenv("TFS_HOST_BUDGET", "32K")
+    st = streaming.scan_parquet(str(path))  # default window >> budget
+    sizes = [w.num_rows for w in st.windows()]
+    assert sum(sizes) == rows
+    # 32K / (4 concurrent * 64 B/row) = 128 rows
+    assert st.window_rows < 1024
+    assert max(sizes) == st.window_rows
+
+
+def test_stream_map_iterator_mode_is_lazy(pq_path):
+    """sink=None returns a lazy iterator: windows flow at the
+    consumer's pace (at most the prefetch lookahead is staged beyond
+    what was pulled), and closing mid-stream releases the accounting."""
+    before = obs.counters()["stream_windows"]
+    it = streaming.map_blocks(
+        lambda x: {"z": x + 1.0}, _scan(pq_path, window_rows=50)
+    )
+    first = next(it)
+    assert first.num_rows == 50
+    from tensorframes_tpu.ops.prefetch import prefetch_depth
+
+    staged = obs.counters()["stream_windows"] - before
+    assert staged <= 2 + prefetch_depth() + 1  # not the whole 20-window stream
+    it.close()
+    assert obs.live_host_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# spill / spool
+# ---------------------------------------------------------------------------
+
+
+def test_spill_store_roundtrip(tmp_path):
+    store = streaming.SpillStore(str(tmp_path / "s"))
+    arrays = {
+        "a": np.arange(12, dtype=np.float64).reshape(3, 4),
+        "b": np.array([1, 2, 3], np.int32),
+    }
+    w0 = obs.counters()["spill_bytes_written"]
+    r0 = obs.counters()["spill_bytes_read"]
+    nbytes = store.put("blk", arrays)
+    assert nbytes > 0
+    assert obs.counters()["spill_bytes_written"] - w0 == nbytes
+    back = store.get("blk")
+    assert obs.counters()["spill_bytes_read"] - r0 == nbytes
+    for k in arrays:
+        np.testing.assert_array_equal(back[k], arrays[k])
+        assert back[k].dtype == arrays[k].dtype
+    store.delete("blk")
+    assert store.get("blk") is None
+
+
+def test_windowed_cache_evicts_to_spill(tmp_path, monkeypatch, devices):
+    """A sharded cache over a windowed frame (no durable host authority)
+    spills budget-evicted shards to TFS_SPILL_DIR and restores them on
+    next use — results identical, spill traffic counted."""
+    monkeypatch.setenv("TFS_SPILL_DIR", str(tmp_path / "spill"))
+    monkeypatch.setenv("TFS_CACHE_SHARDED", "always")
+    # budget fits ~2 of 4 shards: half evict at build time
+    monkeypatch.setenv("TFS_HBM_BUDGET", "5K")
+    x = np.arange(2048, dtype=np.float32).reshape(256, 8)
+    f = tfs.TensorFrame.from_arrays({"x": x}, num_blocks=4)
+    f._host_windowed = True
+    fc = f.cache(sharded=True)
+    cache = fc._cache
+    assert cache is not None and cache.spill is not None
+    assert cache.resident_blocks() < 4
+    assert len(cache._spilled) > 0
+    w0 = obs.counters()["spill_bytes_written"]
+    r0 = obs.counters()["spill_bytes_read"]
+    out = tfs.map_blocks(lambda x: {"z": x * 2.0}, fc)
+    np.testing.assert_array_equal(
+        np.asarray(out.column("z").data), x * 2.0
+    )
+    assert obs.counters()["spill_bytes_read"] > r0  # restores happened
+    assert obs.counters()["spill_bytes_written"] >= w0
+    # release cleans the spill files up
+    spilled_keys = list(cache._spilled)
+    fc.uncache()
+    for bi in spilled_keys:
+        assert cache.spill.get(cache._spill_key(bi)) is None
+
+
+def test_fully_evicted_spill_cache_still_restores(
+    tmp_path, monkeypatch, devices
+):
+    """A spill-backed cache whose shards were ALL evicted to disk must
+    still take the affinity dispatch path and restore per block —
+    otherwise the spilled bytes would be unreachable (round-12 review
+    fix: active_cache keeps a spilled-only cache alive)."""
+    from tensorframes_tpu.ops import frame_cache as fc_mod
+
+    monkeypatch.setenv("TFS_SPILL_DIR", str(tmp_path / "spill"))
+    monkeypatch.setenv("TFS_CACHE_SHARDED", "always")
+    # budget holds ~one 2KB shard: by the end of the build every earlier
+    # shard has been evicted-to-spill; then evict the last one too
+    monkeypatch.setenv("TFS_HBM_BUDGET", "2K")
+    x = np.arange(2048, dtype=np.float32).reshape(256, 8)
+    f = tfs.TensorFrame.from_arrays({"x": x}, num_blocks=4)
+    f._host_windowed = True
+    fc = f.cache(sharded=True)
+    cache = fc._cache
+    for bi in range(4):
+        if cache.blocks[bi] is not None:
+            cache.evict(bi)
+            cache.nbytes[bi] = 0
+    assert cache.resident_blocks() == 0 and len(cache._spilled) == 4
+    assert fc_mod.active_cache(fc) is cache  # spilled-only stays active
+    r0 = obs.counters()["spill_bytes_read"]
+    out = tfs.map_blocks(lambda x: {"z": x + 1.0}, fc)
+    np.testing.assert_array_equal(np.asarray(out.column("z").data), x + 1.0)
+    assert obs.counters()["spill_bytes_read"] > r0
+
+
+def test_one_shot_source_spools_for_reiteration(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFS_SPILL_DIR", str(tmp_path / "spill"))
+
+    def gen():
+        for i in range(5):
+            yield pa.table(
+                {"x": np.arange(i * 10, i * 10 + 10, dtype=np.float64)}
+            )
+
+    st = streaming.from_batches(gen(), window_rows=16)
+    w0 = obs.counters()["spill_bytes_written"]
+    first = [np.asarray(w.column("x").data) for w in st.windows()]
+    assert obs.counters()["spill_bytes_written"] > w0  # spooled
+    r0 = obs.counters()["spill_bytes_read"]
+    second = [np.asarray(w.column("x").data) for w in st.windows()]
+    assert obs.counters()["spill_bytes_read"] > r0  # replayed from disk
+    assert len(first) == len(second)
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_one_shot_source_without_spill_dir_raises(monkeypatch):
+    monkeypatch.setenv("TFS_SPILL_DIR", "")
+
+    def gen():
+        yield pa.table({"x": np.arange(4, dtype=np.float64)})
+
+    st = streaming.from_batches(gen(), window_rows=2)
+    assert sum(w.num_rows for w in st.windows()) == 4
+    with pytest.raises(ValidationError, match="one-shot"):
+        list(st.windows())
+
+
+# ---------------------------------------------------------------------------
+# sinks, cancellation, satellites
+# ---------------------------------------------------------------------------
+
+
+def test_parquet_sink_roundtrip_and_row_groups(pq_path, tmp_path):
+    out = tmp_path / "scored.parquet"
+    summary = streaming.map_blocks(
+        lambda x: {"z": x + 1.0}, _scan(pq_path), sink=str(out)
+    )
+    assert summary["rows"] == N_ROWS and summary["windows"] == 4
+    assert summary["bytes"] > 0
+    back = pq.read_table(str(out))
+    assert back.num_rows == N_ROWS
+    # one row-group batch per window -> the written file itself streams
+    st = streaming.scan_parquet(str(out), window_rows=WINDOW)
+    ref = tfs.map_blocks(
+        lambda x: {"z": x + 1.0}, _windowed_reference(pq_path)
+    )
+    got = np.concatenate(
+        [np.asarray(w.column("z").data) for w in st.windows()]
+    )
+    np.testing.assert_array_equal(got, np.asarray(ref.column("z").data))
+
+
+def test_write_parquet_row_group_size(tmp_path):
+    f = tfs.TensorFrame.from_arrays(
+        {"x": np.arange(1000, dtype=np.float64)}
+    )
+    path = tmp_path / "rg.parquet"
+    f.to_parquet(path, row_group_size=100)
+    assert pq.ParquetFile(str(path)).metadata.num_row_groups == 10
+
+
+def test_mid_stream_cancel_leaves_sink_at_window_boundary(
+    pq_path, tmp_path
+):
+    """A cancel that fires while the stream is mid-flight surfaces as
+    Cancelled AND leaves the parquet sink holding only complete windows
+    (docs/RESILIENCE.md round 12)."""
+    out = tmp_path / "cancelled.parquet"
+    scope = cancellation.CancelScope(label="test")
+
+    class CancellingSink(streaming.ParquetSink):
+        def write(self, frame):
+            super().write(frame)
+            if self.windows == 2:
+                scope.cancel("mid-stream test cancel")
+
+    sink = CancellingSink(str(out))
+    with pytest.raises(cancellation.Cancelled):
+        with cancellation.activate(scope):
+            streaming.map_blocks(
+                lambda x: {"z": x + 1.0}, _scan(pq_path), sink=sink
+            )
+    back = pq.read_table(str(out))
+    assert back.num_rows == 2 * WINDOW  # complete windows only
+    np.testing.assert_array_equal(
+        np.asarray(back.column("z").to_pylist())[:5],
+        np.asarray(
+            tfs.TensorFrame.from_parquet(pq_path).column("x").data
+        )[:5]
+        + 1.0,
+    )
+
+
+def test_copy_path_skip_log_once(tmp_path, caplog):
+    """A streamed source with host-only string columns logs the forced
+    copy path ONCE, naming the columns and reasons."""
+    path = tmp_path / "s.parquet"
+    tbl = pa.table(
+        {
+            "x": np.arange(6, dtype=np.float64),
+            "tag": ["a", "b", "c", "d", "e", "f"],
+        }
+    )
+    pq.write_table(tbl, str(path))
+    with caplog.at_level(logging.WARNING, "tensorframes_tpu.streaming"):
+        for _ in streaming.scan_parquet(str(path), window_rows=2).windows():
+            pass
+        for _ in streaming.scan_parquet(str(path), window_rows=2).windows():
+            pass
+    hits = [
+        r
+        for r in caplog.records
+        if "force the host copy path" in r.getMessage()
+    ]
+    assert len(hits) == 1
+    assert "tag" in hits[0].getMessage()
+    assert "host-only" in hits[0].getMessage()
+
+
+def test_empty_stream_reduce_raises(tmp_path):
+    def gen():
+        return iter(())
+
+    st = streaming.from_batches(gen, window_rows=4)
+    with pytest.raises(ValidationError, match="empty stream"):
+        streaming.reduce_blocks(
+            lambda x_input: {"x": x_input.sum(0)}, st
+        )
+
+
+def test_run_pipeline_over_stream(pq_path):
+    ref = (
+        tfs.pipeline(_windowed_reference(pq_path))
+        .map_blocks(lambda x: {"y": x * 2.0})
+        .map_blocks(lambda y: {"z": y + 1.0})
+        .run()
+    )
+    pipe = (
+        tfs.pipeline(tfs.TensorFrame.from_parquet(pq_path))
+        .map_blocks(lambda x: {"y": x * 2.0})
+        .map_blocks(lambda y: {"z": y + 1.0})
+    )
+    got = streaming.run_pipeline(
+        pipe, _scan(pq_path), sink=streaming.CollectSink()
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.column("z").data), np.asarray(ref.column("z").data)
+    )

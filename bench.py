@@ -22,6 +22,7 @@ reference has no training loop or serving path):
 | 12 | chaos bench: injected transient-fault rate x throughput + bit-identity | SURVEY §5 (r9) |
 | 13 | sharded HBM frame cache: epochs-over-cached-frame, serial vs sharded + adoption | kmeans_demo cache() (r10) |
 | 14 | bridge serving: p50/p99 vs offered concurrency, shed counts, fault legs | PythonInterface.scala seam (r11) |
+| 16 | flight-recorder overhead + Perfetto trace dump + metrics histograms | explain/analyze surface (r13) |
 
 Round 6: the headline record carries ``ceiling_mfu`` (the roofline shape-mix
 ceiling from ``tensorframes_tpu.roofline``) next to the measured ``mfu``;
@@ -1863,6 +1864,212 @@ def bench_stream_frames(jax, tfs) -> None:
 
 
 # ---------------------------------------------------------------------------
+# config #16: observability — flight-recorder overhead + Perfetto dump
+# ---------------------------------------------------------------------------
+
+
+def _observability_measure() -> dict:
+    """The config-16 measurement body: the config-11-shaped pooled
+    ``map_blocks`` workload, (a) flight recorder OFF (the default every
+    other config runs under — its rows/s vs prior rounds is the
+    "disabled overhead is noise" evidence) and (b) recorder ON, dumping
+    a Chrome-trace JSON with a bridge round trip recorded alongside so
+    the file carries device, staging-lane, AND bridge-request tracks.
+    Runs in the bench parent when it has >= 2 local devices, else in the
+    forced-8-host-device CPU child (``TFS_BENCH_OBS_CHILD``)."""
+    import jax
+    import jax.numpy as jnp
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import observability as obs
+
+    n_dev = len(jax.local_devices())
+    rows_per_block, d, K, nb = 64, 16, 300, 16
+    n = rows_per_block * nb
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, d).astype(np.float32)
+    w = ((rng.rand(d, d) - 0.5) / d).astype(np.float32)
+
+    def fn(x):
+        def step(c, _):
+            return jnp.tanh(c @ w), None
+
+        out, _ = jax.lax.scan(step, x, None, length=K)
+        return {"y": out}
+
+    program = tfs.Program.wrap(fn, fetches=["y"])
+    old = {
+        k: os.environ.get(k)
+        for k in ("TFS_DEVICE_POOL", "TFS_PREFETCH_BLOCKS")
+    }
+    os.environ["TFS_DEVICE_POOL"] = "auto"
+    os.environ["TFS_PREFETCH_BLOCKS"] = "2"
+
+    def leg(reps=4):
+        best = float("inf")
+        for rep in range(reps):  # rep 0 = compile warmup
+            frame = tfs.TensorFrame.from_arrays({"x": x}, num_blocks=nb)
+            t0 = time.perf_counter()
+            out = tfs.map_blocks(program, frame)
+            np.asarray(out.column("y").data)
+            dt = time.perf_counter() - t0
+            if rep and dt < best:
+                best = dt
+        return n / best
+
+    try:
+        obs.disable_trace()
+        obs.clear_trace()
+        off_rows_s = leg()
+        obs.enable_trace()
+        obs.clear_trace()
+        on_rows_s = leg()
+        # one bridge round trip under the recorder, so the dump carries
+        # the request/admit/execute lifecycle tracks too
+        from tensorframes_tpu.bridge import BridgeClient, serve
+
+        server = serve()
+        try:
+            host, port = server.address[:2]
+            with BridgeClient(host, port) as client:
+                rf = client.create_frame(
+                    {"x": np.arange(256.0)}, num_blocks=4
+                )
+                rf.collect()
+                metrics = client.metrics()
+        finally:
+            server.close()
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "bench_trace.json"
+        )
+        obs.dump_trace(path)
+        depth, drops = obs.trace_depth(), obs.trace_drops()
+    finally:
+        obs.disable_trace()
+        obs.clear_trace()
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # Perfetto-format validation: the dump must re-parse and carry >= 1
+    # track per pool device plus staging-lane and bridge tracks
+    data = json.load(open(path))
+    tracks = [
+        e["args"]["name"]
+        for e in data["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    ]
+    device_tracks = [t for t in tracks if t.startswith("device/")]
+    lane_tracks = [t for t in tracks if t.startswith("lane/")]
+    bridge_tracks = [t for t in tracks if t.startswith("bridge/")]
+    lat = obs.latency_snapshot()
+    return {
+        "value": round(on_rows_s, 1),
+        "devices": n_dev,
+        "trace_off_rows_s": round(off_rows_s, 1),
+        "enabled_overhead_pct": round(
+            100.0 * (off_rows_s / on_rows_s - 1.0), 2
+        ),
+        "trace_path": path,
+        "trace_events": depth,
+        "trace_drops": drops,
+        "device_tracks": len(device_tracks),
+        "lane_tracks": len(lane_tracks),
+        "bridge_tracks": len(bridge_tracks),
+        "perfetto_json_ok": bool(
+            data["traceEvents"]
+            and len(device_tracks) >= min(n_dev, 2)
+            and lane_tracks
+            and bridge_tracks
+        ),
+        "metrics_histograms_ok": bool(
+            "tfs_verb_latency_seconds_bucket" in metrics
+            and "tfs_bridge_latency_seconds_bucket" in metrics
+            and 'q="p99"' in metrics
+        ),
+        "verb_p99_s": lat.get("verb:map_blocks", {}).get("p99_s"),
+        "bridge_collect_p99_s": lat.get("bridge:collect", {}).get("p99_s"),
+        "workload": (
+            f"map_blocks scan({K} x {d}x{d} matmul) over {n}x{d} f32, "
+            f"{nb} blocks, pooled"
+        ),
+    }
+
+
+def bench_observability(jax, tfs) -> None:
+    """Config 16 (round 13): the flight recorder's enabled-mode overhead
+    on the pooled config-11 workload, plus the Perfetto evidence dump —
+    a Chrome-trace JSON with one track per pool device, per staging
+    lane, and per bridge handler thread — and the Prometheus histogram
+    exposition check.  The OFF leg is the number every other config runs
+    under: comparing it to prior rounds is the "disabled-mode overhead
+    is within noise" proof (the disabled path is one boolean check per
+    block)."""
+    import subprocess
+    import sys
+
+    if len(jax.local_devices()) >= 2:
+        m = _observability_measure()
+        m["forced_host_devices"] = False
+    else:
+        env = dict(os.environ)
+        env["TFS_BENCH_OBS_CHILD"] = "1"
+        env["TFS_BENCH_KEEP_STDERR"] = "1"  # parent owns bench_stderr.log
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        env.pop("TFS_DEVICE_POOL", None)
+        env.pop("TFS_PREFETCH_BLOCKS", None)
+        env.pop("TFS_TRACE", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        if proc.returncode != 0 or not proc.stdout.strip():
+            raise RuntimeError(
+                f"observability child failed (rc={proc.returncode}): "
+                f"{(proc.stderr or proc.stdout)[-400:]}"
+            )
+        m = json.loads(proc.stdout.strip().splitlines()[-1])
+        m["forced_host_devices"] = True
+
+    off = m.get("trace_off_rows_s")
+    value = m.pop("value")
+    _emit(
+        {
+            "metric": (
+                "flight-recorder pooled map_blocks (TFS_TRACE=1, "
+                f"{m.get('devices')} devices)"
+            ),
+            "value": value,
+            "unit": "rows/sec",
+            "vs_baseline": round(value / off, 3) if off and value else None,
+            "baseline": f"same workload, recorder off ({off} rows/s)",
+            "config": 16,
+            **m,
+            "note": (
+                "enabled_overhead_pct is the recorder's cost when ON "
+                "(ring-buffer appends at block granularity); the OFF "
+                "leg is the default every other config measures under, "
+                "so its round-over-round stability is the disabled-"
+                "mode-overhead-within-noise evidence. bench_trace.json "
+                "is Chrome-trace/Perfetto format: device_tracks = "
+                "pooled dispatch+readback lanes, lane_tracks = per-"
+                "device staging, bridge_tracks = request lifecycle"
+            ),
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
 # config #4 (headline, printed last): Inception-v3 map_blocks scoring
 # ---------------------------------------------------------------------------
 
@@ -2126,6 +2333,12 @@ def main() -> None:
         print(json.dumps(_frame_cache_measure()), flush=True)
         return
 
+    # config-16 child mode: forced multi-device topology, flight-recorder
+    # overhead + Perfetto dump legs
+    if os.environ.get("TFS_BENCH_OBS_CHILD") == "1":
+        print(json.dumps(_observability_measure()), flush=True)
+        return
+
     import jax
 
     # persistent XLA executable cache: first-ever compile of Inception over a
@@ -2161,6 +2374,7 @@ def main() -> None:
         bench_frame_cache,
         bench_bridge_serving,
         bench_stream_frames,
+        bench_observability,
         bench_lm_train,
         bench_lm_train_wide,
         bench_decode,

@@ -74,6 +74,18 @@ JAX_PLATFORMS=cpu \
   python -m pytest tests/test_stream_frames.py -q
 rm -rf "$TFS_SPILL_TMP"
 
+# Observability tier: the flight-recorder / histogram / metrics tests
+# re-run with TFS_TRACE=1 LIVE (the main suite pins it off and tests
+# drive the recorder via observability.enable_trace(); this tier proves
+# the env wiring end to end).  The pooled trace test (test_pooled_*)
+# self-isolates into a fresh interpreter via conftest, like the
+# device-pool tier.
+echo "== observability tier (flight recorder + metrics, TFS_TRACE=1 live) =="
+TFS_TRACE=1 TFS_TRACE_EVENTS=65536 \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_trace_metrics.py -q
+
 echo "== pytest =="
 exec python -m pytest tests/ -q --ignore=tests/test_device_pool.py \
   --ignore=tests/test_frame_cache.py "$@"

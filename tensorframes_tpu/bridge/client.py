@@ -83,7 +83,9 @@ def _read_grace_s(remaining_s: float) -> float:
 # including the read-only but EXPENSIVE ``collect`` — gets a token the
 # server dedups: a retry never races a still-running original into a
 # duplicate admission slot (it waits for the original's outcome).
-_SAFE_METHODS = frozenset({"ping", "schema", "health", "hello", "release"})
+_SAFE_METHODS = frozenset(
+    {"ping", "schema", "health", "hello", "release", "metrics"}
+)
 
 
 class BridgeError(RuntimeError):
@@ -510,9 +512,17 @@ class BridgeClient:
 
     def health(self) -> Dict[str, Any]:
         """The server's health snapshot: admission depth, drain state,
-        quarantined devices, HBM budget occupancy (ungated — works on a
-        saturated server)."""
+        quarantined devices, HBM budget occupancy, and (round 13) the
+        gauge snapshot — live/peak host bytes, flight-recorder
+        depth/drops (ungated — works on a saturated server)."""
         return self.call("health")
+
+    def metrics(self) -> str:
+        """The server's Prometheus text exposition (round 13): counters,
+        gauges, and the verb/bridge-method latency histograms with
+        p50/p95/p99 — the scrape surface for deployments without the
+        ``TFS_METRICS_PORT`` HTTP endpoint (ungated, like ``health``)."""
+        return self.call("metrics")["text"]
 
     def create_frame(
         self,

@@ -13,7 +13,11 @@ exactly-once).  Responses carry ``{"id": n, "result": ...}`` or
 code-specific fields (``retry_after_ms``, ``leaked_frame_ids``).  All
 round-11 keys are additive and ignorable — the framing is unchanged, so
 the protocol version stays 2 (the version exists to prevent *stream
-corruption*, not to gate optional envelope keys).
+corruption*, not to gate optional envelope keys).  Round 13 adds one
+METHOD, not a wire change: ``metrics`` (ungated, like ``health``)
+returns ``{"text": <Prometheus exposition>}`` — an old server answers
+it with the standard unknown-method error, so the version stays 2 here
+too.
 Small tensors ride inline as ``{"__tensor__": {"dtype", "shape",
 "data"(b64)}}``; binary cells as ``{"__bytes__": b64}``.
 

@@ -37,6 +37,14 @@ blocks the driver thread per call; a front-end for real traffic cannot:
 * **Chaos**: ``TFS_FAULT_INJECT`` bridge kinds (``bridge_stall`` /
   ``bridge_delay`` / ``bridge_drop``) exercise all of the above
   deterministically (``faults.maybe_inject_bridge``).
+* **Telemetry** (round 13): every request records its end-to-end wall
+  time (admission wait included) into the per-method latency
+  histograms (``observability.latency_snapshot`` / ``metrics_text``);
+  an ungated ``metrics`` RPC serves the Prometheus text exposition;
+  ``health`` carries the gauge snapshot (host-byte high-water,
+  flight-recorder depth/drops); with ``TFS_TRACE=1`` each request
+  leaves ``request``/``admit``/``execute`` events on its handler
+  thread's flight-recorder track (``docs/OBSERVABILITY.md``).
 """
 
 from __future__ import annotations
@@ -123,6 +131,17 @@ _UNGATED_METHODS = frozenset({"ping", "schema", "release"})
 # how long a retried request waits for its still-running original
 # execution's outcome before giving up with ``retry_conflict``
 _IDEM_WAIT_CAP_S = 600.0
+
+# the complete method surface, for latency-histogram labelling: series
+# are keyed by method name, so a client-supplied UNKNOWN name must not
+# mint a new series per request (unbounded label cardinality = memory
+# growth + metrics bloat on a long-lived server) — everything outside
+# this set records under one "unknown" label
+_ALL_METHODS = (
+    _GATED_METHODS
+    | _UNGATED_METHODS
+    | frozenset({"hello", "health", "metrics", "end_session"})
+)
 
 
 class BridgeServerError(RuntimeError):
@@ -655,10 +674,31 @@ class _Handler(socketserver.StreamRequestHandler):
     # -- per-request processing ---------------------------------------------
 
     def _run_method(self, msg: dict, rbins: list):
+        """Latency/trace envelope around :meth:`_dispatch` (round 13):
+        every bridge method — gated or not, success or refusal — records
+        its END-TO-END wall time (admission wait included) into the
+        ``bridge`` latency-histogram family, and with the flight
+        recorder on, a ``request <method>`` event on this handler
+        thread's track."""
+        method = msg.get("method")
+        label = method if method in _ALL_METHODS else "unknown"
+        track = (
+            f"bridge/{threading.current_thread().name.split(' ')[0]}"
+        )
+        t0 = time.perf_counter()
+        t_tr = t0 if observability.trace_enabled() else None
+        try:
+            return self._dispatch(msg, rbins, method, track)
+        finally:
+            observability.record_latency(
+                "bridge", label, time.perf_counter() - t0
+            )
+            observability.trace_complete(f"request {label}", track, t_tr)
+
+    def _dispatch(self, msg: dict, rbins: list, method, track: str):
         """-> ``(reply_without_id, bins)``; raises ``_DropReply`` for an
         injected dropped reply and structured exceptions for refusals."""
         server = self.server  # type: ignore[attr-defined]
-        method = msg.get("method")
         if not isinstance(method, str) or method.startswith("_"):
             raise AttributeError(f"unknown method {method!r}")
 
@@ -681,6 +721,10 @@ class _Handler(socketserver.StreamRequestHandler):
             return {
                 "result": encode_value(server.health_snapshot(), bins)
             }, bins
+        if method == "metrics":
+            # ungated like health: a saturated or draining server must
+            # still be scrapeable — that is when the metrics matter
+            return {"result": {"text": server.metrics_text()}}, []
 
         sess = self._session
         if sess is None:
@@ -782,8 +826,14 @@ class _Handler(socketserver.StreamRequestHandler):
         # token, and waiters are woken even when admission refuses
         entry = None
         try:
+            # flight recorder: admission wait and execution are separate
+            # events on this handler's track, so queueing-vs-compute time
+            # is visible per request in the Perfetto view
+            t_admit = observability.trace_now()
             server.gate.admit(scope)
+            observability.trace_complete(f"admit {method}", track, t_admit)
             server._register_scope(scope)
+            t_exec = observability.trace_now()
             try:
                 with observability.verb_span(
                     f"bridge:{method}", 0, 0
@@ -819,6 +869,9 @@ class _Handler(socketserver.StreamRequestHandler):
                         reply, bins = {"error": payload}, []
                         entry = ("error", payload, [])
             finally:
+                observability.trace_complete(
+                    f"execute {method}", track, t_exec
+                )
                 server._unregister_scope(scope)
                 server.gate.release()
         finally:
@@ -929,6 +982,32 @@ class BridgeServer(socketserver.ThreadingTCPServer):
                 target=self._reap_loop, name="tfs-bridge-reaper", daemon=True
             )
             t.start()
+        # metrics exposition (round 13): the admission gauges register as
+        # providers so the standalone TFS_METRICS_PORT endpoint (started
+        # here from the env when set) scrapes them alongside the process
+        # counters/histograms; close() unregisters exactly these
+        # closures, so a replacement server's providers survive
+        # ONE grouped provider, not three: the gauges come from a single
+        # gate.snapshot() per scrape, so inflight/queued/draining are
+        # mutually consistent (three independent lambdas could read
+        # three different gate states mid-load).  No shed gauge: the
+        # process-wide ``bridge_shed`` counter already exposes sheds as
+        # tfs_bridge_shed_total — a same-named gauge would emit a
+        # duplicate TYPE family.
+        self._gauge_providers = {
+            "tfs_bridge_admission": self._admission_gauges,
+        }
+        for name, fn in self._gauge_providers.items():
+            observability.register_gauge(name, fn)
+        observability.maybe_start_metrics_server()
+
+    def _admission_gauges(self) -> Dict[str, Any]:
+        s = self.gate.snapshot()
+        return {
+            "tfs_bridge_inflight": s["inflight"],
+            "tfs_bridge_queued": s["queued"],
+            "tfs_bridge_draining": int(s["draining"]),
+        }
 
     @property
     def address(self):
@@ -1044,7 +1123,26 @@ class BridgeServer(socketserver.ThreadingTCPServer):
                     "devices_quarantined",
                 )
             },
+            # round 13: the gauge snapshot serving operators need
+            # without scraping the metrics endpoint — host-byte
+            # high-water and flight-recorder depth/drop state
+            "gauges": {
+                "live_host_bytes": observability.live_host_bytes(),
+                "peak_host_bytes": c["peak_host_bytes"],
+                "trace_enabled": observability.trace_enabled(),
+                "trace_events": observability.trace_depth(),
+                "trace_drops": observability.trace_drops(),
+            },
         }
+
+    def metrics_text(self) -> str:
+        """The ``metrics`` RPC body: the process-wide Prometheus text
+        (counters, gauges, verb + bridge latency histograms) with THIS
+        server's admission gauges merged in — a multi-server process's
+        RPC always reflects the server that answered it."""
+        return observability.metrics_text(
+            extra_gauges=self._admission_gauges()
+        )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -1061,6 +1159,8 @@ class BridgeServer(socketserver.ThreadingTCPServer):
             return
         self._closed = True
         self._reaper_stop.set()
+        for name, fn in self._gauge_providers.items():
+            observability.unregister_gauge(name, fn)
         budget = self.drain_s if drain_s is None else float(drain_s)
         self.gate.start_draining()
         if not self.gate.wait_idle(budget):

@@ -24,21 +24,49 @@ The reference's observability is a Logging trait + log4j config + pervasive
   ``counters()`` snapshots them; enabled spans attach the per-verb delta
   as ``retrace``; ``bench.py`` attaches the per-config delta to every
   record — compile counts are *proven*, not asserted.
+* **flight recorder** (round 13) — an opt-in bounded ring buffer
+  (``TFS_TRACE=1``, capacity ``TFS_TRACE_EVENTS``) of structured events
+  at *block* granularity: engine serial/pooled/sharded dispatches,
+  per-lane staging, overlapped D2H readback, retry/quarantine/OOM-split
+  instants, cache evictions/spills, streaming windows, and the bridge
+  request lifecycle.  ``dump_trace(path)`` exports Chrome-trace JSON —
+  one track per device and per staging lane — that Perfetto /
+  ``chrome://tracing`` open directly, so pool occupancy and H2D/compute
+  overlap become visually inspectable.  Disabled (the default), every
+  emission site is one boolean check.
+* **latency histograms** (round 13) — always-on log2-bucket latency
+  distributions for every verb and every bridge method
+  (``latency_snapshot()`` derives p50/p95/p99), replacing "latency only
+  exists in bench postprocessing".  One ``bisect`` into 28 buckets plus
+  a dict increment per verb call.
+* **metrics exposition** (round 13) — ``metrics_text()`` renders the
+  counters, gauges (``peak_host_bytes``, HBM budget occupancy, trace
+  depth/drops, registered providers), and latency histograms in
+  Prometheus text format; served as the bridge's ungated ``metrics``
+  RPC and, with ``TFS_METRICS_PORT`` set, a stdlib-HTTP ``/metrics``
+  endpoint (:func:`maybe_start_metrics_server`).
 
 Deliberately cheap: a disabled span is one ``if``; a counter bump is one
 dict increment under an uncontended lock (bridge handler threads bump
 concurrently since round 11; the paths are at most per-block, never
-per-element).
+per-element); a disabled trace emission is one boolean check.
 """
 
 from __future__ import annotations
 
+import bisect
+import collections
 import contextlib
 import contextvars
+import copy
+import json
 import logging
+import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+from .envutil import env_int, warn_once
 
 logger = logging.getLogger("tensorframes_tpu")
 _verb_log = logging.getLogger("tensorframes_tpu.verbs")
@@ -378,6 +406,546 @@ def counters_delta(
     }
 
 
+# -- flight recorder (round 13) -----------------------------------------------
+#
+# A bounded ring buffer of structured events, recorded at BLOCK (never
+# per-element) granularity by the execution stack: engine dispatch loops,
+# prefetch staging lanes, PoolRun readback, fault-tolerance instants,
+# cache evictions/spills, streaming windows, and the bridge request
+# lifecycle.  Off by default: every emission site is a single boolean
+# check (``trace_enabled``), so the suite's timing-sensitive fences and
+# the serving hot path pay nothing.  Events carry perf_counter-derived
+# microsecond timestamps relative to one process epoch; ``dump_trace``
+# renders them as Chrome-trace JSON with one track ("thread") per device
+# / staging lane, which Perfetto and chrome://tracing open directly.
+
+ENV_TRACE = "TFS_TRACE"
+ENV_TRACE_EVENTS = "TFS_TRACE_EVENTS"
+DEFAULT_TRACE_EVENTS = 65536
+
+_TRACE_TRUTHY = ("1", "true", "yes", "on")
+
+_trace_lock = threading.Lock()
+_trace_buf: "collections.deque" = collections.deque()
+_trace_state: Dict[str, Any] = {
+    # tri-state: None follows TFS_TRACE; True/False is an API pin
+    # (enable_trace()/disable_trace()), which wins over the env so tests
+    # control the recorder regardless of the suite's pinned baseline
+    "override": None,
+    "capacity": None,  # None follows TFS_TRACE_EVENTS
+    "drops": 0,
+    "epoch": time.perf_counter(),
+}
+
+
+def trace_enabled() -> bool:
+    """Whether the flight recorder is on (API override, else
+    ``TFS_TRACE``).  The one check every emission site pays when
+    disabled."""
+    ov = _trace_state["override"]
+    if ov is not None:
+        return bool(ov)
+    return os.environ.get(ENV_TRACE, "").strip().lower() in _TRACE_TRUTHY
+
+
+def enable_trace(capacity: Optional[int] = None) -> None:
+    """Turn the flight recorder on (wins over ``TFS_TRACE``).
+    ``capacity`` overrides ``TFS_TRACE_EVENTS`` for the ring buffer."""
+    if capacity is not None:
+        _trace_state["capacity"] = max(1, int(capacity))
+    _trace_state["override"] = True
+
+
+def disable_trace() -> None:
+    """Pin the flight recorder off (wins over ``TFS_TRACE``)."""
+    _trace_state["override"] = False
+
+
+def clear_trace() -> None:
+    """Drop every buffered event and reset the drop counter (the epoch
+    is kept: timestamps stay comparable across clears)."""
+    with _trace_lock:
+        _trace_buf.clear()
+        _trace_state["drops"] = 0
+
+
+def _trace_capacity() -> int:
+    cap = _trace_state["capacity"]
+    if cap is not None:
+        return cap
+    return env_int(ENV_TRACE_EVENTS, DEFAULT_TRACE_EVENTS, floor=1)
+
+
+def _trace_append(ev: Dict[str, Any]) -> None:
+    cap = _trace_capacity()
+    with _trace_lock:
+        _trace_buf.append(ev)
+        while len(_trace_buf) > cap:
+            # ring semantics: the OLDEST event drops and is accounted —
+            # a dump that hit capacity says how much history it lost
+            _trace_buf.popleft()
+            _trace_state["drops"] += 1
+
+
+def trace_now() -> Optional[float]:
+    """``time.perf_counter()`` when tracing, else None — the start-stamp
+    helper for call sites that must not pay a clock read when disabled
+    (pair with :func:`trace_complete`, which no-ops on ``t0=None``)."""
+    return time.perf_counter() if trace_enabled() else None
+
+
+def trace_complete(
+    name: str, track: str, t0: Optional[float],
+    t1: Optional[float] = None, **args: Any,
+) -> None:
+    """Record one complete ("X") event spanning ``[t0, t1]`` on
+    ``track``.  No-op when disabled or ``t0`` is None.  ``args`` must be
+    JSON-safe primitives (they land in the Chrome-trace ``args`` pane)."""
+    if t0 is None or not trace_enabled():
+        return
+    if t1 is None:
+        t1 = time.perf_counter()
+    e = _trace_state["epoch"]
+    ev: Dict[str, Any] = {
+        "name": name,
+        "ph": "X",
+        "track": track,
+        "ts": round((t0 - e) * 1e6, 3),
+        "dur": round(max(0.0, t1 - t0) * 1e6, 3),
+    }
+    if args:
+        ev["args"] = args
+    _trace_append(ev)
+
+
+def trace_instant(name: str, track: str = "events", **args: Any) -> None:
+    """Record one instant ("i") event — retries, quarantines, evictions,
+    sheds: things that happen AT a moment rather than over one."""
+    if not trace_enabled():
+        return
+    ev: Dict[str, Any] = {
+        "name": name,
+        "ph": "i",
+        "track": track,
+        "ts": round((time.perf_counter() - _trace_state["epoch"]) * 1e6, 3),
+    }
+    if args:
+        ev["args"] = args
+    _trace_append(ev)
+
+
+@contextlib.contextmanager
+def trace_span(name: str, track: str, **args: Any):
+    """Context-manager form of :func:`trace_complete`."""
+    t0 = trace_now()
+    try:
+        yield
+    finally:
+        trace_complete(name, track, t0, **args)
+
+
+def trace_depth() -> int:
+    """Events currently buffered."""
+    with _trace_lock:
+        return len(_trace_buf)
+
+
+def trace_drops() -> int:
+    """Events dropped to the ring capacity since the last
+    :func:`clear_trace`."""
+    with _trace_lock:
+        return _trace_state["drops"]
+
+
+def trace_events(n: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The buffered events (oldest first; the last ``n`` when given), as
+    DEEP copies — callers cannot mutate the live ring, nested ``args``
+    dicts included (the same guarantee :func:`last_spans` makes)."""
+    with _trace_lock:
+        evs = list(_trace_buf)
+    if n is not None:
+        evs = evs[-n:]
+    return [copy.deepcopy(ev) for ev in evs]
+
+
+def dump_trace(path: str) -> str:
+    """Write the buffered events as Chrome-trace JSON to ``path`` and
+    return it.  One pseudo-thread per distinct track (named via
+    ``thread_name`` metadata), so Perfetto / chrome://tracing render one
+    swim lane per device, per staging lane, per bridge handler thread.
+    ``otherData.dropped_events`` records how much history the ring lost."""
+    with _trace_lock:
+        events = [dict(ev) for ev in _trace_buf]
+        drops = _trace_state["drops"]
+    tracks = sorted({ev["track"] for ev in events})
+    tids = {t: i + 1 for i, t in enumerate(tracks)}
+    out: List[Dict[str, Any]] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 0,
+            "tid": 0,
+            "args": {"name": "tensorframes_tpu"},
+        }
+    ]
+    for t, tid in tids.items():
+        out.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 0,
+                "tid": tid,
+                "args": {"name": t},
+            }
+        )
+    for ev in events:
+        rec: Dict[str, Any] = {
+            "name": ev["name"],
+            "ph": ev["ph"],
+            "pid": 0,
+            "tid": tids[ev["track"]],
+            "ts": ev["ts"],
+        }
+        if ev["ph"] == "X":
+            rec["dur"] = ev["dur"]
+        else:
+            rec["s"] = "t"  # instant scope: thread
+        if "args" in ev:
+            rec["args"] = ev["args"]
+        out.append(rec)
+    payload = {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {"dropped_events": drops},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+# -- latency histograms (round 13) -------------------------------------------
+#
+# Always-on, lock-cheap latency distributions: log2 buckets from ~1 µs to
+# 64 s (28 counters per series), one bisect + three scalar updates per
+# observation.  Two families: ``("verb", <verb>)`` recorded by every
+# verb_span exit, and ``("bridge", <method>)`` recorded by the bridge
+# server around the WHOLE request (admission wait included).  Quantiles
+# are derived by linear interpolation inside the landing bucket — exact
+# to the bucket's factor-of-2 bounds, which is what p50/p95/p99 SLO
+# tracking needs without per-sample storage.
+
+_LATENCY_MIN_EXP = -20  # 2**-20 s ≈ 0.95 µs
+_LATENCY_MAX_EXP = 6  # 64 s; beyond that lands in the +Inf bucket
+_LATENCY_BOUNDS = [
+    2.0 ** e for e in range(_LATENCY_MIN_EXP, _LATENCY_MAX_EXP + 1)
+]
+
+
+class _LatencyHisto:
+    """One series' bucket counts + count/sum/max (no per-sample state)."""
+
+    __slots__ = ("counts", "count", "sum", "max")
+
+    def __init__(self):
+        self.counts = [0] * (len(_LATENCY_BOUNDS) + 1)  # + overflow
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.counts[bisect.bisect_left(_LATENCY_BOUNDS, seconds)] += 1
+        self.count += 1
+        self.sum += seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def quantile(self, q: float) -> float:
+        """Estimated ``q``-quantile: linear interpolation inside the
+        bucket the rank lands in (the overflow bucket interpolates up to
+        the observed max)."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo = _LATENCY_BOUNDS[i - 1] if i > 0 else 0.0
+                hi = (
+                    _LATENCY_BOUNDS[i]
+                    if i < len(_LATENCY_BOUNDS)
+                    else max(self.max, lo)
+                )
+                return lo + (hi - lo) * (target - cum) / c
+            cum += c
+        return self.max
+
+
+_latency_lock = threading.Lock()
+_latency: Dict[Tuple[str, str], _LatencyHisto] = {}
+
+# kind -> (Prometheus family, label name); unknown kinds render
+# generically as tfs_<kind>_latency_seconds{label=...}
+_LATENCY_FAMILIES = {"verb": "verb", "bridge": "method"}
+
+
+def record_latency(kind: str, label: str, seconds: float) -> None:
+    """Record one observation into the ``(kind, label)`` series."""
+    with _latency_lock:
+        h = _latency.get((kind, label))
+        if h is None:
+            h = _latency[(kind, label)] = _LatencyHisto()
+        h.record(seconds)
+
+
+def latency_snapshot() -> Dict[str, Dict[str, Any]]:
+    """Per-series summary — ``{"verb:map_blocks": {count, sum_s, max_s,
+    p50_s, p95_s, p99_s}, ...}`` — the programmatic face of the
+    histograms (``metrics_text`` is the operator face)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    with _latency_lock:
+        for (kind, label), h in sorted(_latency.items()):
+            out[f"{kind}:{label}"] = {
+                "count": h.count,
+                "sum_s": round(h.sum, 6),
+                "max_s": round(h.max, 6),
+                "p50_s": round(h.quantile(0.50), 9),
+                "p95_s": round(h.quantile(0.95), 9),
+                "p99_s": round(h.quantile(0.99), 9),
+            }
+    return out
+
+
+def reset_latency() -> None:
+    """Drop every latency series (tests / bench legs metering their own
+    window)."""
+    with _latency_lock:
+        _latency.clear()
+
+
+# -- metrics exposition (round 13) -------------------------------------------
+
+ENV_METRICS_PORT = "TFS_METRICS_PORT"
+
+# gauge providers: components with live state the exposition should poll
+# (the bridge server registers its admission gauges here so the stdlib
+# HTTP endpoint sees them without observability importing the bridge)
+_gauges_lock = threading.Lock()
+_gauge_providers: Dict[str, Callable[[], float]] = {}
+
+
+def register_gauge(name: str, fn: Callable[[], Any]) -> None:
+    """Register a zero-arg callable polled by :func:`metrics_text`
+    (last registration wins; provider exceptions skip the gauge rather
+    than failing the scrape).  A provider returning a number becomes
+    gauge ``name``; a provider returning a Mapping contributes one
+    gauge per item — the grouped form exists so related gauges (the
+    bridge's admission inflight/queued/draining) come from ONE state
+    snapshot per scrape instead of three racing reads."""
+    with _gauges_lock:
+        _gauge_providers[name] = fn
+
+
+def unregister_gauge(name: str, fn: Optional[Callable] = None) -> None:
+    """Remove gauge ``name`` — only when still bound to ``fn`` if given,
+    so a closed server cannot unregister its replacement's provider."""
+    with _gauges_lock:
+        if fn is None or _gauge_providers.get(name) is fn:
+            _gauge_providers.pop(name, None)
+
+
+def _fmt_metric(v: Any) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def metrics_text(
+    extra_gauges: Optional[Mapping[str, Any]] = None
+) -> str:
+    """The process's metrics in Prometheus text exposition format
+    (0.0.4): every scalar counter as ``tfs_<name>_total``, the gauges
+    (host-byte high-water, HBM budget occupancy, trace-recorder
+    depth/drops, registered providers, ``extra_gauges``), and the
+    latency histograms with derived p50/p95/p99 quantile gauges.  Served
+    by the bridge's ungated ``metrics`` RPC and the optional
+    ``TFS_METRICS_PORT`` HTTP endpoint."""
+    lines: List[str] = []
+    emitted: set = set()  # family names already declared (no dup TYPEs)
+    c = counters()
+    for k in sorted(c):
+        if k in ("by_verb", "peak_host_bytes"):
+            continue  # peak_host_bytes is a gauge, not a counter
+        name = f"tfs_{k}_total"
+        emitted.add(name)
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_fmt_metric(c[k])}")
+    gauges: Dict[str, Any] = {
+        "tfs_peak_host_bytes": c["peak_host_bytes"],
+        "tfs_live_host_bytes": live_host_bytes(),
+        "tfs_trace_buffer_events": trace_depth(),
+        "tfs_trace_dropped_events": trace_drops(),
+    }
+    try:  # lazy: frame_cache imports observability, never the reverse
+        from .ops import frame_cache
+
+        gauges["tfs_hbm_budget_bytes"] = frame_cache.hbm_budget()
+        gauges["tfs_hbm_resident_bytes"] = (
+            frame_cache.budget_bytes_resident()
+        )
+    except Exception:  # noqa: BLE001 — a scrape must never fail on this
+        pass
+    with _gauges_lock:
+        providers = dict(_gauge_providers)
+    for name, fn in providers.items():
+        try:
+            v = fn()
+        except Exception:  # noqa: BLE001 — skip a sick provider
+            continue
+        if isinstance(v, collections.abc.Mapping):
+            gauges.update(v)  # grouped provider: one snapshot, N gauges
+        else:
+            gauges[name] = v
+    for k, v in (extra_gauges or {}).items():
+        gauges[k] = v
+    for name in sorted(gauges):
+        if name in emitted:
+            # a provider/extra gauge colliding with a counter family
+            # would emit a duplicate TYPE line and break strict
+            # Prometheus parsers — the counter wins, the gauge is
+            # skipped (register under a distinct name instead)
+            continue
+        emitted.add(name)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt_metric(gauges[name])}")
+    with _latency_lock:
+        by_kind: Dict[str, List[Tuple[str, _LatencyHisto]]] = {}
+        for (kind, label), h in sorted(_latency.items()):
+            by_kind.setdefault(kind, []).append((label, h))
+        for kind in sorted(by_kind):
+            fam = f"tfs_{kind}_latency_seconds"
+            lab = _LATENCY_FAMILIES.get(kind, "label")
+            lines.append(f"# TYPE {fam} histogram")
+            for label, h in by_kind[kind]:
+                sel = f'{lab}="{_escape_label(label)}"'
+                cum = 0
+                for i, cnt in enumerate(h.counts):
+                    cum += cnt
+                    le = (
+                        repr(_LATENCY_BOUNDS[i])
+                        if i < len(_LATENCY_BOUNDS)
+                        else "+Inf"
+                    )
+                    lines.append(
+                        f'{fam}_bucket{{{sel},le="{le}"}} {cum}'
+                    )
+                lines.append(f"{fam}_sum{{{sel}}} {repr(h.sum)}")
+                lines.append(f"{fam}_count{{{sel}}} {h.count}")
+            qfam = f"tfs_{kind}_latency_quantile_seconds"
+            lines.append(f"# TYPE {qfam} gauge")
+            for label, h in by_kind[kind]:
+                sel = f'{lab}="{_escape_label(label)}"'
+                for qname, q in (
+                    ("p50", 0.50), ("p95", 0.95), ("p99", 0.99)
+                ):
+                    lines.append(
+                        f'{qfam}{{{sel},q="{qname}"}} '
+                        f"{repr(h.quantile(q))}"
+                    )
+    return "\n".join(lines) + "\n"
+
+
+_metrics_httpd = None
+_metrics_httpd_lock = threading.Lock()
+
+
+def start_metrics_server(port: int, host: str = "127.0.0.1"):
+    """Serve ``GET /metrics`` (Prometheus text) on a stdlib HTTP server
+    running on a daemon thread; returns the server (``.server_address``
+    carries the bound port — ``port=0`` binds ephemeral).  Idempotent:
+    a process runs at most one metrics server."""
+    import http.server
+
+    class _MetricsHandler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - http.server API
+            if self.path.split("?", 1)[0] != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = metrics_text().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # noqa: D102 - silence stderr
+            pass
+
+    global _metrics_httpd
+    with _metrics_httpd_lock:
+        if _metrics_httpd is not None:
+            return _metrics_httpd
+        httpd = http.server.ThreadingHTTPServer((host, port), _MetricsHandler)
+        httpd.daemon_threads = True
+        threading.Thread(
+            target=httpd.serve_forever, name="tfs-metrics", daemon=True
+        ).start()
+        _metrics_httpd = httpd
+        logger.info(
+            "metrics endpoint serving on http://%s:%d/metrics",
+            *httpd.server_address[:2],
+        )
+    return httpd
+
+
+def stop_metrics_server() -> None:
+    global _metrics_httpd
+    with _metrics_httpd_lock:
+        httpd, _metrics_httpd = _metrics_httpd, None
+    if httpd is not None:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def maybe_start_metrics_server():
+    """Start the ``/metrics`` endpoint when ``TFS_METRICS_PORT`` names a
+    port (> 0); None otherwise.  Called by ``BridgeServer.__init__`` so
+    a served deployment gets scrape-ability from the env alone; safe to
+    call repeatedly.  A bind failure (port already held — e.g. two
+    server processes on one host, or a stale restart) logs once and
+    returns None: optional telemetry must never stop the data plane
+    from starting.  Call :func:`start_metrics_server` directly when a
+    failed bind should be an error."""
+    port = env_int(ENV_METRICS_PORT, 0)
+    if port <= 0:
+        return None
+    try:
+        return start_metrics_server(port)
+    except OSError as e:
+        warn_once(
+            logger,
+            f"observability:metrics-port:{port}",
+            "could not bind the %s=%d metrics endpoint (%s); continuing "
+            "without it",
+            ENV_METRICS_PORT,
+            port,
+            e,
+        )
+        return None
+
+
 def initialize_logging(level=logging.INFO, stream=None) -> None:
     """Configure the framework loggers with a sane handler/format.
 
@@ -396,7 +964,33 @@ def initialize_logging(level=logging.INFO, stream=None) -> None:
 
 def enable(profile_dir: Optional[str] = None) -> None:
     """Turn on per-verb phase spans (and jax.profiler traces when
-    ``profile_dir`` is given)."""
+    ``profile_dir`` is given).
+
+    ``profile_dir`` semantics, explicit since round 13: EVERY verb call
+    is wrapped in its own ``jax.profiler.trace`` dump under the
+    directory, and jax supports **one active profiler trace per
+    process** — so per-verb profiling is a single-threaded diagnosis
+    tool.  When verbs overlap (threaded bridge handlers, user threads),
+    the verb that arrives second runs *unprofiled* (its span still
+    records; a warning logs once) rather than crashing the data plane
+    inside jax's second-trace error.  The directory is created here, up
+    front, and a jax build without profiler support fails here with a
+    clear error instead of at the first verb call."""
+    if profile_dir is not None:
+        try:
+            import jax.profiler
+
+            if not callable(getattr(jax.profiler, "trace", None)):
+                raise AttributeError(
+                    "jax.profiler.trace is missing or not callable"
+                )
+        except Exception as e:  # noqa: BLE001 — surfaced with context
+            raise RuntimeError(
+                f"observability.enable(profile_dir=...) requires a jax "
+                f"build with profiler support ({type(e).__name__}: {e}); "
+                f"call enable() without profile_dir for plain spans"
+            ) from e
+        os.makedirs(profile_dir, exist_ok=True)
     _state["enabled"] = True
     _state["profile_dir"] = profile_dir
 
@@ -411,8 +1005,11 @@ def is_enabled() -> bool:
 
 
 def last_spans(n: int = 10) -> List[Dict[str, Any]]:
-    """The most recent verb spans, newest last."""
-    return [dict(s) for s in _state["spans"][-n:]]
+    """The most recent verb spans, newest last — DEEP copies, so a
+    caller mutating a returned record's nested ``retrace`` / annotation
+    dicts (bench postprocessing does exactly that) can never corrupt
+    the live buffer."""
+    return [copy.deepcopy(s) for s in _state["spans"][-n:]]
 
 
 class _Span:
@@ -424,7 +1021,12 @@ class _Span:
         self.verb = verb
         self.meta = meta
         self.phases: Dict[str, float] = {}
-        self._counters0 = dict(_counters)
+        # snapshot UNDER the counters lock: bridge handler threads (and
+        # pool lane fallbacks) bump concurrently, and an unlocked
+        # dict(_counters) can observe a torn mid-update view exactly when
+        # the span's retrace delta matters most
+        with _counters_lock:
+            self._counters0 = dict(_counters)
         self._t0 = time.perf_counter()
         self._last = self._t0
 
@@ -475,14 +1077,25 @@ class _NullSpan:
 _NULL = _NullSpan()
 
 
+# jax.profiler allows ONE active trace per process (see ``enable``); the
+# gate hands it to whichever verb arrives first and lets overlapping
+# verbs run unprofiled with a once-per-process warning
+_profiler_gate = threading.Lock()
+
+
 @contextlib.contextmanager
 def verb_span(verb: str, rows: int, blocks: int):
     """Context manager wrapping one verb invocation.
 
     Yields a span with ``.mark(phase)``; a no-op singleton when disabled.
     Always tags the thread with the verb name so the retrace counters
-    attribute traces/compiles per verb even with spans disabled."""
+    attribute traces/compiles per verb even with spans disabled; always
+    records the verb's wall time into the latency histograms (round 13)
+    — and, with the flight recorder on, a whole-verb event on the
+    ``verbs`` track."""
     token = _current_verb.set(verb)
+    t_verb = time.perf_counter()
+    t_trace = t_verb if trace_enabled() else None
     try:
         if not _state["enabled"]:
             yield _NULL
@@ -493,7 +1106,23 @@ def verb_span(verb: str, rows: int, blocks: int):
             if profile_dir:
                 import jax
 
-                with jax.profiler.trace(profile_dir):
+                if _profiler_gate.acquire(blocking=False):
+                    try:
+                        with jax.profiler.trace(profile_dir):
+                            yield span
+                    finally:
+                        _profiler_gate.release()
+                else:
+                    # a concurrent verb holds the one process-wide
+                    # profiler trace: run unprofiled, never crash
+                    warn_once(
+                        logger,
+                        "observability:profiler-busy",
+                        "jax.profiler supports one trace at a time; a "
+                        "concurrent verb is being profiled, so %s runs "
+                        "unprofiled (spans still record)",
+                        verb,
+                    )
                     yield span
             else:
                 yield span
@@ -505,3 +1134,9 @@ def verb_span(verb: str, rows: int, blocks: int):
             span._finish()
     finally:
         _current_verb.reset(token)
+        if not verb.startswith("bridge:"):
+            # bridge methods are recorded end-to-end (admission wait
+            # included) by the server itself — recording the execution
+            # span here too would double-count the family
+            record_latency("verb", verb, time.perf_counter() - t_verb)
+        trace_complete(verb, "verbs", t_trace, rows=rows, blocks=blocks)

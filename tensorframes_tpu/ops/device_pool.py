@@ -270,6 +270,9 @@ class PoolRun:
         with _quarantine_lock:
             _quarantine_history.add(di)
         observability.note_device_quarantined()
+        observability.trace_instant(
+            "quarantine", "faults", device=di, failures=self.failures[di]
+        )
         healthy = len(self.devices) - len(self.quarantined)
         logger.warning(
             "device %d quarantined after %d transient failures; "
@@ -354,6 +357,12 @@ class PoolRun:
         t0 = time.perf_counter()
         out_blocks[bi] = {k: np.asarray(v) for k, v in outs.items()}
         now = time.perf_counter()
+        # flight recorder: the D2H materialisation is where a pooled
+        # block actually syncs — its track placement shows per-device
+        # readback overlap in the Perfetto timeline
+        observability.trace_complete(
+            f"readback b{bi}", f"device/{di}", t0, now, block=bi, device=di
+        )
         self.drain_s += now - t0
         self._last_done[di] = now
 

@@ -723,6 +723,7 @@ class Executor:
             # block boundary is the check granularity — one contextvar
             # read when no scope is active
             cancellation.checkpoint()
+            t_blk = observability.trace_now()  # flight recorder (r13)
             n_rows = block_sizes[bi]
             if plans[bi] is not None:
                 outs = self._run_block_streamed(
@@ -758,6 +759,9 @@ class Executor:
                     # bit-identical to the exact-shape path)
                     outs = {k: v[:n_rows] for k, v in outs.items()}
             self._check_block_outputs(program, outs, n_rows, rows_level, trim)
+            observability.trace_complete(
+                f"{verb} b{bi}", "serial", t_blk, block=bi, rows=n_rows
+            )
             out_blocks.append(outs)
         # the loop consumed every item, so the staging thread has finished
         # (its last stats write happened-before the last queue get): pf.stats
@@ -1109,6 +1113,7 @@ class Executor:
         column can reach a donating executable.  Streamed blocks
         (``plans``) keep chunk-granular staging, pointed at their
         assigned device."""
+        verb = "map_rows" if rows_level else "map_blocks"
         sizes = frame.block_sizes
         nb = frame.num_blocks
         assignment = device_pool.assign(sizes, len(devices))
@@ -1149,6 +1154,7 @@ class Executor:
         lane_dead = [False] * (1 if single_iter is not None else len(devices))
         for bi in range(nb):
             cancellation.checkpoint()  # block boundary (pooled loop)
+            t_blk = observability.trace_now()  # flight recorder (r13)
             di = assignment[bi]
             li = 0 if single_iter is not None else di
             it = single_iter if single_iter is not None else lane_iters[di]
@@ -1192,6 +1198,10 @@ class Executor:
                 if pads[bi] is not None:
                     outs = {k: v[:n_rows] for k, v in outs.items()}
             self._check_block_outputs(program, outs, n_rows, rows_level, trim)
+            observability.trace_complete(
+                f"{verb} b{bi}", f"device/{di_eff}", t_blk,
+                block=bi, rows=n_rows, device=di_eff,
+            )
             pool.submit(bi, di_eff, n_rows, outs, out_blocks)
         pool.finish(out_blocks)
         staged_blocks = sum(1 for p in plans if p is None)
@@ -1284,6 +1294,7 @@ class Executor:
         restaged = 0
         for bi in range(nb):
             cancellation.checkpoint()  # block boundary (sharded loop)
+            t_blk = observability.trace_now()  # flight recorder (r13)
             di = cache.assignment[bi]
             di_eff = pool.effective_device(di) if session is not None else di
             shard = cache.shard(bi)
@@ -1331,6 +1342,10 @@ class Executor:
                 if pads[bi] is not None:
                     outs = {k: v[:n_rows] for k, v in outs.items()}
             self._check_block_outputs(program, outs, n_rows, rows_level, trim)
+            observability.trace_complete(
+                f"{verb} b{bi}", f"device/{di_eff}", t_blk,
+                block=bi, rows=n_rows, device=di_eff, shard_hit=used,
+            )
             pool.submit(bi, di_eff, n_rows, outs, out_blocks)
         pool.finish(out_blocks)
         span.annotate("device_pool", pool.record())
@@ -2037,6 +2052,7 @@ class Executor:
             partials: List[Dict[str, jnp.ndarray]] = []
             for bi in nonempty:
                 cancellation.checkpoint()  # block boundary (partials)
+                t_blk = observability.trace_now()  # flight recorder
 
                 def attempt(a, dev_i, _bi=bi):
                     block = frame.block(_bi)
@@ -2054,6 +2070,10 @@ class Executor:
                     partials.append(
                         session.run(bi, sizes[bi], attempt, device=0)
                     )
+                observability.trace_complete(
+                    f"reduce b{bi}", "serial", t_blk,
+                    block=bi, rows=sizes[bi],
+                )
             if session is not None and session.events():
                 span.annotate("fault_tolerance", session.record())
             span.mark("dispatch_partials")
@@ -2081,6 +2101,7 @@ class Executor:
         partials = []
         for k, bi in enumerate(nonempty):
             cancellation.checkpoint()  # block boundary (pooled partials)
+            t_blk = observability.trace_now()  # flight recorder (r13)
             di = assignment[k]
             if session is None:
                 arrays = next(lane_iters[di])
@@ -2112,6 +2133,10 @@ class Executor:
                 )
                 di_eff = pool.effective_device(di)
             pool.note_dispatch(di_eff, sizes[bi])
+            observability.trace_complete(
+                f"reduce b{bi}", f"device/{di_eff}", t_blk,
+                block=bi, rows=sizes[bi], device=di_eff,
+            )
             # async hop to the combine device: one reduced cell per base
             partials.append(
                 {b: jax.device_put(p[b], combine) for b in bases}
@@ -2152,6 +2177,7 @@ class Executor:
         hits = 0
         for bi in nonempty:
             cancellation.checkpoint()  # block boundary (sharded partials)
+            t_blk = observability.trace_now()  # flight recorder (r13)
             di = cache.assignment[bi]
             shard0 = cache.shard(bi)
             has_shard = shard0 is not None and any(
@@ -2205,6 +2231,11 @@ class Executor:
                 hits += 1
                 observability.note_cache_shard_hit()
             pool.note_dispatch(di_eff, sizes[bi])
+            observability.trace_complete(
+                f"reduce b{bi}", f"device/{di_eff}", t_blk,
+                block=bi, rows=sizes[bi], device=di_eff,
+                shard_hit=used["v"],
+            )
             # async hop to the combine device: one reduced cell per base
             partials.append(
                 {b: jax.device_put(p[b], combine) for b in bases}

@@ -236,6 +236,14 @@ class FrameRetrySession:
                 )
                 self.retries += 1
                 observability.note_block_retry()
+                observability.trace_instant(
+                    "retry",
+                    "faults",
+                    verb=self.verb,
+                    block=bi,
+                    attempt=attempt + 1,
+                    device=dev_i,
+                )
                 logger.warning(
                     "%s: block %d (device %s) transient failure, retry "
                     "%d/%d after %.3fs: %r",
@@ -260,6 +268,9 @@ class FrameRetrySession:
         """One binary OOM split performed for block ``bi``."""
         self.oom_splits += 1
         observability.note_oom_split()
+        observability.trace_instant(
+            "oom_split", "faults", verb=self.verb, block=bi
+        )
 
     def note_cache_restage(self) -> None:
         """One cached block rebuilt from its authoritative host copy

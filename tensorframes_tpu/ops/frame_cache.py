@@ -253,6 +253,9 @@ class FrameCache:
                 observability.note_h2d_bytes(arr.nbytes)
                 staged[name] = jax.device_put(arr, dev)
             if self.insert(bi, staged):
+                observability.trace_instant(
+                    "spill_restore", "cache", block=bi
+                )
                 return self.blocks[bi]
             # the budget cannot hold it even now — the disk copy stays
             # the only copy; the caller falls back
@@ -267,6 +270,7 @@ class FrameCache:
         immutable, so re-writing identical bytes would be pure I/O
         waste in exactly the tight-budget thrash regime spill serves)."""
         shard = self.blocks[bi]
+        spilled_now = False
         if (
             shard is not None
             and self.spill is not None
@@ -275,6 +279,15 @@ class FrameCache:
             host = {k: np.asarray(v) for k, v in shard.items()}
             self.spill.put(self._spill_key(bi), host)
             self._spilled.add(bi)
+            spilled_now = True
+        if shard is not None:
+            observability.trace_instant(
+                "evict",
+                "cache",
+                block=bi,
+                bytes=self.nbytes[bi],
+                spilled=spilled_now,
+            )
         self.blocks[bi] = None
         self.nbytes[bi] = 0
 

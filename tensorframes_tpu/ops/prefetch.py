@@ -73,6 +73,8 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import numpy as np
 
+from .. import observability
+
 DEFAULT_DEPTH = 2
 
 
@@ -198,11 +200,17 @@ class Prefetcher:
                             f"{self._n} items"
                         ) from e
                     return  # unbounded source exhausted
-                dt = time.perf_counter() - t0
+                t1 = time.perf_counter()
+                dt = t1 - t0
                 self.stats["stage_s"] += dt
                 self.stats["wait_s"] += dt
                 if self._n is None:
                     self.stats["items"] += 1
+                # flight recorder: one event per staged item on this
+                # lane's track (synchronous path: staging == waiting)
+                observability.trace_complete(
+                    f"stage {i}", f"lane/{self._name}", t0, t1, item=i
+                )
                 yield v
                 i += 1
             return
@@ -239,9 +247,15 @@ class Prefetcher:
                             # otherwise block on the queue forever)
                             raise
                         break  # unbounded source exhausted
-                    self.stats["stage_s"] += time.perf_counter() - t0
+                    t1 = time.perf_counter()
+                    self.stats["stage_s"] += t1 - t0
                     if self._n is None:
                         self.stats["items"] += 1
+                    # flight recorder: staging timeline per lane — the
+                    # H2D/compute-overlap half of the Perfetto view
+                    observability.trace_complete(
+                        f"stage {i}", f"lane/{self._name}", t0, t1, item=i
+                    )
                     if not put((v, None)):
                         return
                     i += 1
@@ -296,8 +310,6 @@ def stage_columns(
     the per-column transfers of a multi-column frame queue on the link
     together instead of being issued lazily by the consuming jit call.
     Device-resident values pass through untouched."""
-    from .. import observability
-
     staged = {}
     for name, arr in cols.items():
         if isinstance(arr, jax.Array):

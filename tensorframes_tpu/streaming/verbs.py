@@ -111,8 +111,21 @@ def _drain_to_sink(outputs, sink, span_name: str, stream: StreamFrame):
     with observability.verb_span(span_name, 0, 0) as span:
         windows = rows = 0
         try:
-            for out in outputs:
+            it = iter(outputs)
+            while True:
+                # the window's verb dispatch happens inside next(): the
+                # flight-recorder event spans compute + sink write, one
+                # event per window on the "stream" track
+                t_win = observability.trace_now()
+                try:
+                    out = it.__next__()
+                except StopIteration:
+                    break
                 sink.write(out)
+                observability.trace_complete(
+                    f"window {windows}", "stream", t_win,
+                    window=windows, rows=out.num_rows,
+                )
                 windows += 1
                 rows += out.num_rows
                 del out
@@ -239,6 +252,7 @@ def _reduce_stream(program, stream: StreamFrame, mode, engine, verb: str):
         windows = rows = 0
         for wf in stream.windows():
             cancellation.checkpoint()
+            t_win = observability.trace_now()
             if setup is None:
                 setup = (
                     ex._reduce_rows_setup(program, wf, mode)
@@ -248,6 +262,10 @@ def _reduce_stream(program, stream: StreamFrame, mode, engine, verb: str):
             bases, reduced, run = setup
             partials.extend(
                 ex._reduce_partials(run, bases, reduced, wf, merged)
+            )
+            observability.trace_complete(
+                f"window {windows}", "stream", t_win,
+                window=windows, rows=wf.num_rows,
             )
             windows += 1
             rows += wf.num_rows
@@ -331,6 +349,7 @@ def aggregate(
         windows = rows = 0
         for wf in stream.windows():
             cancellation.checkpoint()
+            t_win = observability.trace_now()
             part = ex.aggregate(program, GroupedFrame(wf, keys))
             acc = (
                 part
@@ -339,6 +358,10 @@ def aggregate(
                     program,
                     GroupedFrame(_concat_partial_frames(acc, part), keys),
                 )
+            )
+            observability.trace_complete(
+                f"window {windows}", "stream", t_win,
+                window=windows, rows=wf.num_rows,
             )
             windows += 1
             rows += wf.num_rows

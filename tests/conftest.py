@@ -66,6 +66,19 @@ os.environ.setdefault("TFS_HOST_BUDGET", "")
 os.environ.setdefault("TFS_STREAM_WINDOW", "")
 os.environ.setdefault("TFS_STREAM_BLOCKS", "")
 
+# Observability (round 13): the flight recorder and the HTTP metrics
+# endpoint stay OFF in the main suite — trace events are process-global
+# ring-buffer state recorded at block granularity, and a port-bound
+# endpoint is serving infrastructure, not test infrastructure.  Tests
+# drive the recorder through the API (observability.enable_trace()
+# overrides the env); run_tests.sh's observability tier re-runs the
+# trace/metrics tests with TFS_TRACE=1 exported, which wins over these
+# absence-defaults like every other tier's knobs.  The always-on latency
+# histograms need no pin: they never trace, compile, or dispatch.
+os.environ.setdefault("TFS_TRACE", "0")
+os.environ.setdefault("TFS_TRACE_EVENTS", "")
+os.environ.setdefault("TFS_METRICS_PORT", "")
+
 import jax  # noqa: E402
 
 # The axon environment's sitecustomize force-registers the TPU backend and

@@ -86,6 +86,19 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 JAX_PLATFORMS=cpu \
   python -m pytest tests/test_trace_metrics.py -q
 
+# Planner tier: the lazy verb-graph planner's tests re-run with
+# TFS_PLAN=1 LIVE (the main suite pins it off via conftest and the
+# tests opt in per frame via frame.lazy(); this tier proves the env
+# routing end to end — module-level verbs return LazyFrames and the
+# optimized plans stay bit-identical).  Pooled planner tests
+# (test_pooled_*) self-isolate into fresh interpreters via conftest on
+# the forced 8-device mesh, like the device-pool tier.
+echo "== planner tier (lazy verb-graph planner, TFS_PLAN=1 live) =="
+TFS_PLAN=1 \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_planner.py -q
+
 echo "== pytest =="
 exec python -m pytest tests/ -q --ignore=tests/test_device_pool.py \
   --ignore=tests/test_frame_cache.py "$@"

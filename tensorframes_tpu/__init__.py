@@ -28,6 +28,7 @@ from .dtypes import ScalarType, by_name as scalar_type, supported_types
 from .frame import TensorFrame
 from .ops import (
     Executor,
+    LazyFrame,
     Pipeline,
     ValidationError,
     aggregate,
@@ -94,6 +95,7 @@ __all__ = [
     "ShapeError",
     "UNKNOWN",
     "Executor",
+    "LazyFrame",
     "ValidationError",
     "aggregate",
     "group_by",

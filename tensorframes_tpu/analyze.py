@@ -75,5 +75,13 @@ def print_schema(frame: TensorFrame) -> None:
 
 def explain(frame: TensorFrame) -> str:
     """Pretty-printed tensor schema (reference ``explain``,
-    ``DebugRowOps.scala:528-545`` / ``DataFrameInfo.scala:10-17``)."""
+    ``DebugRowOps.scala:528-545`` / ``DataFrameInfo.scala:10-17``).
+
+    For a *planned* frame (``frame.lazy()`` / ``TFS_PLAN``, round 14)
+    this renders the optimized logical plan instead — stage list, fused
+    groups, pruned columns, cache insertions, and the last run's
+    per-group pool/serial decisions — without executing anything.
+    Eager frames keep the round-1 schema rendering."""
+    if getattr(frame, "_tfs_lazy", False):
+        return frame.explain_plan()
     return frame.schema.explain()

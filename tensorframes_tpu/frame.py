@@ -565,6 +565,25 @@ class TensorFrame:
 
         return GroupedFrame(self, keys)
 
+    def lazy(self) -> "Any":
+        """Switch this frame into *planned* mode (``ops/planner.py``,
+        round 14): verbs called on the returned LazyFrame append to a
+        logical plan instead of dispatching, and the optimized plan —
+        adjacent maps fused into one dispatch, dead columns pruned
+        before staging, twice-consumed subplans auto-cached sharded —
+        executes on first materialisation (``collect``/``to_arrays``/…,
+        a reduce verb, ``aggregate``).  ``tfs.explain`` renders the
+        plan.  Eager execution (calling verbs on ``self``) stays the
+        default and is bit-identical.
+
+        One shared plan root per frame object: repeated ``lazy()``
+        calls return the same node, so chains built from separate
+        ``lazy()`` calls still count as consumers of one subplan (the
+        auto-cache trigger)."""
+        from .ops.planner import root_for
+
+        return root_for(self)
+
     # -- materialisation -----------------------------------------------------
 
     def collect(self) -> List[Dict[str, Any]]:

@@ -120,6 +120,12 @@ _counters: Dict[str, int] = {
     "spill_bytes_written": 0,
     "spill_bytes_read": 0,
     "peak_host_bytes": 0,
+    # lazy verb-graph planner (round 14): fused dispatches executed,
+    # source columns pruned from staging, and sharded caches the
+    # optimizer auto-inserted on twice-consumed subplans
+    "plan_fused_dispatches": 0,
+    "plan_columns_pruned": 0,
+    "plan_cache_inserts": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
 
@@ -264,6 +270,24 @@ def note_bridge_verb_executed() -> None:
     _bump("bridge_verbs_executed")
 
 
+def note_plan_fused_dispatch() -> None:
+    """One fused group (>= 2 adjacent map stages composed into one
+    program) dispatched by the lazy planner (``ops/planner.py``)."""
+    _bump("plan_fused_dispatches")
+
+
+def note_plan_columns_pruned(n: int) -> None:
+    """``n`` source columns a fused dispatch never staged because no
+    downstream stage consumes them (dead-column pruning)."""
+    _bump("plan_columns_pruned", int(n))
+
+
+def note_plan_cache_insert() -> None:
+    """One sharded cache auto-inserted by the planner on a subplan with
+    >= 2 consumers."""
+    _bump("plan_cache_inserts")
+
+
 def note_stream_window() -> None:
     """One streamed window materialised into host columns by the
     windowed reader (``streaming/reader.py``)."""
@@ -402,6 +426,9 @@ def counters_delta(
             "stream_windows",
             "spill_bytes_written",
             "spill_bytes_read",
+            "plan_fused_dispatches",
+            "plan_columns_pruned",
+            "plan_cache_inserts",
         )
     }
 

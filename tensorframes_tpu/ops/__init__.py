@@ -11,12 +11,14 @@ from .engine import (
     warmup,
 )
 from .pipeline import Pipeline, pipeline
+from .planner import LazyFrame
 from .validation import ValidationError
 
 __all__ = [
     "Executor",
     "aggregate",
     "group_by",
+    "LazyFrame",
     "map_blocks",
     "map_rows",
     "Pipeline",

@@ -127,6 +127,10 @@ class GroupedFrame:
 
 
 def group_by(frame: TensorFrame, *keys: str) -> GroupedFrame:
+    if getattr(frame, "_tfs_lazy", False):
+        # LazyFrame: materialise the plan (aggregate's group structure
+        # is data-dependent), counting the grouping as one consumer
+        return frame.group_by(*keys)
     return GroupedFrame(frame, keys)
 
 
@@ -2873,6 +2877,31 @@ def _wrap(fn, fetches, feed_dict=None, shapes=None) -> Program:
     return program
 
 
+def _lazy_target(frame, engine):
+    """The LazyFrame a map verb should append to instead of
+    dispatching, or None for the eager path (``ops/planner.py``:
+    the frame is lazy via ``frame.lazy()``, or ``TFS_PLAN=1`` routes
+    plain frames).  An explicit ``engine=`` (mesh executors) always
+    stays eager — a plan targets the default engine's dispatch
+    surface."""
+    if engine is not None:
+        return None
+    from . import planner
+
+    return planner.maybe_lazy(frame)
+
+
+def _lazy_frame(frame):
+    """Materialise a LazyFrame argument for verbs that are
+    materialisation points (reduce/aggregate over plain frames,
+    warmup)."""
+    if getattr(frame, "_tfs_lazy", False):
+        from . import planner
+
+        return planner.ensure_frame(frame)
+    return frame
+
+
 def map_blocks(
     fn,
     frame: TensorFrame,
@@ -2887,8 +2916,18 @@ def map_blocks(
     reference ``core.py:213-253``).
 
     ``host_stage``: input name -> host preprocessing fn (binary decode).
-    ``shapes``: output name -> block-shape hint (``ShapeDescription``)."""
+    ``shapes``: output name -> block-shape hint (``ShapeDescription``).
+
+    Planned mode (``ops/planner.py``): a ``frame.lazy()`` frame — or any
+    frame under ``TFS_PLAN=1`` — records the verb on a logical plan and
+    returns a LazyFrame; the optimized plan executes on first
+    materialisation."""
     program = _wrap(fn, fetches, feed_dict, shapes)
+    lazy = _lazy_target(frame, engine)
+    if lazy is not None:
+        return lazy._append(
+            "map_blocks", program, trim=trim, host_stage=host_stage
+        )
     return _resolve(engine).map_blocks(
         program, frame, trim=trim, host_stage=host_stage
     )
@@ -2905,8 +2944,12 @@ def map_rows(
 ) -> TensorFrame:
     """Apply a row-level program to every row (``tfs.map_rows``,
     reference ``core.py:175-211``).  ``shapes`` hints are per-row cell
-    shapes."""
+    shapes.  Planned mode records the verb lazily (see
+    :func:`map_blocks`)."""
     program = _wrap(fn, fetches, feed_dict, shapes)
+    lazy = _lazy_target(frame, engine)
+    if lazy is not None:
+        return lazy._append("map_rows", program, host_stage=host_stage)
     return _resolve(engine).map_rows(program, frame, host_stage=host_stage)
 
 
@@ -2919,9 +2962,15 @@ def reduce_rows(
     engine: Optional[Executor] = None,
 ) -> Dict[str, np.ndarray]:
     """Pairwise-reduce all rows to one (``tfs.reduce_rows``,
-    reference ``core.py:138-173``)."""
+    reference ``core.py:138-173``).  A LazyFrame argument is a
+    materialisation point: the optimized plan executes first, then the
+    reduce runs eagerly over the result."""
     program = _wrap(fn, fetches, shapes=shapes)
-    return _resolve(engine).reduce_rows(program, frame, mode=mode)
+    if engine is None and getattr(frame, "_tfs_lazy", False):
+        return frame._reduce("reduce_rows", program, mode=mode)
+    return _resolve(engine).reduce_rows(
+        program, _lazy_frame(frame), mode=mode
+    )
 
 
 def reduce_blocks(
@@ -2932,9 +2981,12 @@ def reduce_blocks(
     engine: Optional[Executor] = None,
 ) -> Dict[str, np.ndarray]:
     """Block-reduce then combine across blocks (``tfs.reduce_blocks``,
-    reference ``core.py:255-291``)."""
+    reference ``core.py:255-291``).  A LazyFrame argument is a
+    materialisation point (see :func:`reduce_rows`)."""
     program = _wrap(fn, fetches, shapes=shapes)
-    return _resolve(engine).reduce_blocks(program, frame)
+    if engine is None and getattr(frame, "_tfs_lazy", False):
+        return frame._reduce("reduce_blocks", program)
+    return _resolve(engine).reduce_blocks(program, _lazy_frame(frame))
 
 
 def aggregate(
@@ -2945,8 +2997,12 @@ def aggregate(
     engine: Optional[Executor] = None,
 ) -> TensorFrame:
     """Keyed algebraic aggregation (``tfs.aggregate``,
-    reference ``core.py:319-336``)."""
+    reference ``core.py:319-336``).  Grouping a LazyFrame materialises
+    the plan (group structure is data-dependent); the aggregate itself
+    always runs eagerly over the materialised frame."""
     program = _wrap(fn, fetches, shapes=shapes)
+    if getattr(grouped.frame, "_tfs_lazy", False):
+        grouped = GroupedFrame(_lazy_frame(grouped.frame), grouped.keys)
     return _resolve(engine).aggregate(program, grouped)
 
 
@@ -2962,6 +3018,7 @@ def warmup(
     """AOT-compile the map-verb executables ``fn`` will run over
     ``frame`` (persistent-cache cold start; see ``Executor.warmup``)."""
     program = Program.wrap(fn, fetches, feed_dict)
+    frame = _lazy_frame(frame)
     return _resolve(engine).warmup(
         program, frame, rows_level=rows_level, host_stage=host_stage
     )

@@ -101,6 +101,39 @@ def _block_info(name: str, st, cell_shape) -> ColumnInfo:
     return ColumnInfo(name, st, Shape(cell_shape).prepend(UNKNOWN))
 
 
+def analyzed_outputs(
+    program: Program,
+    infos: Mapping[str, ColumnInfo],
+    cell: bool,
+    verb: str = "pipeline",
+) -> Dict[str, ColumnInfo]:
+    """Shape-infer a map stage's outputs from its input ColumnInfos —
+    the schema-tracking step shared by the fused Pipeline builders and
+    the lazy planner's composed-program fusion (``ops/planner.py``).
+    ``cell``: the program is row-level (map_rows), so specs and output
+    shapes are per-cell."""
+    specs = {}
+    for n, ci in infos.items():
+        st = dtypes.coerce(ci.scalar_type)
+        shape = (
+            tuple(ci.cell_shape)
+            if cell
+            else (UNKNOWN,) + tuple(ci.cell_shape)
+        )
+        specs[n] = (st, Shape(shape))
+    outs: Dict[str, ColumnInfo] = {}
+    for s in program.analyze(specs):
+        if s.is_output:
+            block_shape = s.shape.prepend(UNKNOWN) if cell else s.shape
+            if not cell and block_shape.rank == 0:
+                raise ValidationError(
+                    f"{verb}.map_blocks: output {s.name!r} is a scalar; "
+                    f"block outputs need a lead row axis."
+                )
+            outs[s.name] = ColumnInfo(s.name, s.scalar_type, block_shape)
+    return outs
+
+
 def _reduce_src_cols(program, bases, suffix: str) -> Dict[str, str]:
     """base -> source chain column for a terminal reduce stage,
     honouring feed-dict renames (round 11): ``inputs={"x_input":
@@ -216,26 +249,7 @@ class Pipeline:
         self, program: Program, infos: Mapping[str, ColumnInfo], cell: bool
     ) -> Dict[str, ColumnInfo]:
         """Shape-infer a map stage's outputs to keep schema tracking exact."""
-        specs = {}
-        for n, ci in infos.items():
-            st = dtypes.coerce(ci.scalar_type)
-            shape = tuple(ci.cell_shape) if cell else (UNKNOWN,) + tuple(
-                ci.cell_shape
-            )
-            specs[n] = (st, Shape(shape))
-        outs: Dict[str, ColumnInfo] = {}
-        for s in program.analyze(specs):
-            if s.is_output:
-                block_shape = (
-                    s.shape.prepend(UNKNOWN) if cell else s.shape
-                )
-                if not cell and block_shape.rank == 0:
-                    raise ValidationError(
-                        f"pipeline.map_blocks: output {s.name!r} is a scalar; "
-                        f"block outputs need a lead row axis."
-                    )
-                outs[s.name] = ColumnInfo(s.name, s.scalar_type, block_shape)
-        return outs
+        return analyzed_outputs(program, infos, cell, verb="pipeline")
 
     def map_blocks(self, fn, trim: bool = False, **kw) -> "Pipeline":
         """Append a block-level map (``tfs.map_blocks``; trim=True for
@@ -1269,6 +1283,12 @@ def pipeline(frame: TensorFrame, engine=None) -> Pipeline:
     ``engine``: pass a ``parallel.MeshExecutor`` to run the chain
     mesh-global — source columns sharded over its data axis, reduce
     combines on ICI (module docstring)."""
+    if getattr(frame, "_tfs_lazy", False):
+        # explicit Pipeline over a lazy frame: materialise the plan
+        # first — a Pipeline is its own fusion surface
+        from . import planner
+
+        frame = planner.ensure_frame(frame)
     if (
         engine is not None
         and hasattr(engine, "mesh")

@@ -1,0 +1,1426 @@
+"""Lazy verb-graph planner: fuse, prune, auto-cache (``TFS_PLAN``, round 14).
+
+The reference exposes a *logical plan* surface — ``explain``/``analyze``
+describe what will run before anything does (PAPER.md §L3) — but every
+verb in this port executed eagerly until this round: a chained
+``map -> map -> map`` pays one dispatch per verb, under the device pool
+each link re-stages the previous verb's host-assembled output, and a
+twice-consumed intermediate (the kmeans-epochs shape) re-stages per
+consumer unless the user remembers ``cache(sharded=True)``.
+
+``frame.lazy()`` (or ``TFS_PLAN=1`` for the module-level verbs) switches
+a frame into *planned* mode: map verbs append :class:`PlanStep`\\ s to a
+logical plan instead of dispatching, and the plan is optimized and
+executed on first materialisation (``collect``/``to_arrays``/…, a
+reduce verb, or ``aggregate``).  The optimizer:
+
+* **fuses** maximal runs of adjacent map stages into ONE chained
+  dispatch: each block is staged once (pruned), the stages' OWN
+  compiled entries (``Program.jitted``/``vmapped`` — the exact
+  executables the eager verbs run, bucket plans and persistent compile
+  cache included) apply back-to-back on the block's device, and one
+  readback returns the chain's outputs.  Under the pool this removes
+  the per-verb host-assembly + re-staging round trip entirely; on the
+  serial path intermediates stay device-resident.  Deliberately NOT a
+  single XLA trace of the whole chain: XLA contracts arithmetic across
+  stage boundaries (a stage-1 ``mul`` feeding a stage-2 ``add`` becomes
+  one fma), which would round differently from the eager per-verb
+  dispatches — per-stage executables make the six-verb bit-identity
+  invariant structural instead of numerical luck;
+* **prunes dead columns before staging**: the chain stages exactly the
+  source columns some stage consumes, so columns no stage reads are
+  never ``device_put`` (``h2d_bytes_staged`` drops measurably).  For
+  non-trimmed chains the pruned columns still ride into the output
+  frame as untouched host passthroughs — same values, zero transfer;
+* **auto-inserts a sharded cache** when a subplan has >= 2 consumers
+  (two derived chains, or repeated terminal consumption — epochs):
+  pooled chain outputs are donation-ADOPTED as the result's shards
+  (``frame_cache.adopt``), and re-consumed intermediates get
+  ``cache(sharded=True)``-style placement over exactly the columns
+  downstream stages read.  Either way a ``weakref.finalize`` releases
+  the shards (refunding ``TFS_HBM_BUDGET``) when the planned frame is
+  garbage-collected;
+* **chooses pool vs fused-serial per fused group** from the existing
+  roofline cost model (``roofline._aggregate_cost`` over the composed
+  chain's compiled HLO → flops/byte) and the retrace state (a plan
+  whose stage executables are already warm pools for free; a cold,
+  transfer-bound chain stays serial — device-resident chaining, no
+  per-device compiles).  The decision — and why — is recorded in the
+  ``plan`` span annotation and rendered by ``tfs.explain``.
+
+Eager execution stays the default (``TFS_PLAN`` unset / ``0``); every
+planned verb is bit-identical to its eager counterpart, including the
+pooled, sharded-cache, and fault-injection legs
+(``tests/test_planner.py``).  Column ORDER of a planned map-terminal
+output may differ from the eager chain's (derived outputs sort together
+before source passthroughs); names and values are identical.
+
+Knobs:
+
+* ``TFS_PLAN`` — ``1``/``true`` routes the module-level verbs through
+  the planner for plain frames; ``frame.lazy()`` opts in per frame
+  regardless of the env.
+* ``TFS_PLAN_POOL_MIN_INTENSITY`` — flops/byte below which a COLD fused
+  group prefers the serial fused dispatch over the device pool (default
+  ``1.0``; warm executables always pool when the pool is available).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import weakref
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from .. import cancellation, dtypes, observability
+from .. import roofline as _roofline
+from ..frame import TensorFrame
+from ..program import Program
+from ..schema import ColumnInfo
+from ..shape import UNKNOWN
+from . import (
+    bucketing,
+    device_pool,
+    fault_tolerance,
+    frame_cache,
+    prefetch,
+    segment_compile,
+)
+from .engine import _DEFAULT, Executor, GroupedFrame, _check_shape_hints
+from .pipeline import analyzed_outputs
+from .validation import ValidationError
+
+_log = logging.getLogger("tensorframes_tpu.planner")
+
+ENV_PLAN = "TFS_PLAN"
+ENV_POOL_INTENSITY = "TFS_PLAN_POOL_MIN_INTENSITY"
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def planning_enabled() -> bool:
+    """Whether ``TFS_PLAN`` routes the module-level verbs through the
+    planner for plain frames (read per call: bench legs and tests flip
+    it mid-process)."""
+    return os.environ.get(ENV_PLAN, "").strip().lower() in _TRUTHY
+
+
+def pool_min_intensity() -> float:
+    raw = os.environ.get(ENV_POOL_INTENSITY, "").strip()
+    if not raw:
+        return 1.0
+    try:
+        return float(raw)
+    except ValueError:
+        return 1.0
+
+
+class _SerialExecutor(Executor):
+    """The fused-serial dispatch target: the exact default engine with
+    the device-pool scheduler opted out — the planner's per-group
+    "serial" decision, expressed the same way ``MeshExecutor`` opts out
+    (``supports_device_pool``) so no dispatch-loop code forks."""
+
+    supports_device_pool = False
+
+
+_SERIAL = _SerialExecutor()
+
+
+# ---------------------------------------------------------------------------
+# plan steps + fusion metadata
+# ---------------------------------------------------------------------------
+
+
+class PlanStep:
+    """One recorded map verb (reduce/aggregate are materialisation
+    points, not steps)."""
+
+    __slots__ = ("kind", "program", "trim", "host_stage")
+
+    def __init__(
+        self,
+        kind: str,
+        program: Program,
+        trim: bool = False,
+        host_stage: Optional[Mapping[str, Any]] = None,
+    ):
+        self.kind = kind  # "map_blocks" | "map_rows"
+        self.program = program
+        self.trim = trim
+        self.host_stage = host_stage
+
+    @property
+    def label(self) -> str:
+        if self.kind == "map_blocks" and self.trim:
+            return "map_blocks_trimmed"
+        return self.kind
+
+    @property
+    def stage_bound(self) -> bool:
+        """Whether this step must run eagerly because it carries host
+        preprocessing (explicit ``host_stage`` or an importer
+        ``host_prelude``) — host fns cannot join a fused chain."""
+        return bool(self.host_stage) or bool(
+            getattr(self.program, "host_prelude", None)
+        )
+
+
+def _device_infos(frame: TensorFrame) -> Dict[str, ColumnInfo]:
+    """Device-feedable uniform columns of a concrete frame — the
+    columns a fused chain may consume."""
+    out: Dict[str, ColumnInfo] = {}
+    for c in frame.columns:
+        if c.info.scalar_type.device_ok and not c.is_ragged:
+            out[c.info.name] = c.info
+    return out
+
+
+# Per-stage shape inference is an eval_shape trace (~ms): an epochs loop
+# rebuilding the same chain would pay it per stage per epoch, which is
+# pure overhead on a hot path that dispatches in single-digit ms.  Keyed
+# by program identity + the exact input info signature, weakref-guarded
+# like the fusion cache.
+_ANALYSIS_CACHE: "collections.OrderedDict[Any, Tuple[Any, Dict]]" = (
+    collections.OrderedDict()
+)
+_ANALYSIS_CACHE_CAP = 256
+
+
+def _analyzed_outputs_cached(
+    program: Program, infos: Mapping[str, ColumnInfo], cell: bool
+) -> Dict[str, ColumnInfo]:
+    key = (
+        id(program),
+        cell,
+        tuple(
+            sorted(
+                (n, ci.scalar_type.name, tuple(ci.block_shape))
+                for n, ci in infos.items()
+            )
+        ),
+    )
+    hit = _ANALYSIS_CACHE.get(key)
+    if hit is not None:
+        ref, outs = hit
+        if ref() is program:
+            _ANALYSIS_CACHE.move_to_end(key)
+            return outs
+        del _ANALYSIS_CACHE[key]
+    outs = analyzed_outputs(program, infos, cell=cell, verb="plan")
+    _ANALYSIS_CACHE[key] = (weakref.ref(program), outs)
+    while len(_ANALYSIS_CACHE) > _ANALYSIS_CACHE_CAP:
+        _ANALYSIS_CACHE.popitem(last=False)
+    return outs
+
+
+def _fusable_run(
+    steps: Sequence[PlanStep], visible: Dict[str, ColumnInfo]
+) -> Tuple[int, Optional[str], Dict[str, ColumnInfo]]:
+    """Length of the maximal fusable prefix of ``steps`` given the
+    ``visible`` device-feedable columns at entry, the reason the run
+    stopped (None when it covered every step), and the visible columns
+    AFTER the prefix (so callers can keep walking a chain).
+
+    A step fuses when: no host stage, every input resolves to a visible
+    device-feedable uniform column, and shape inference succeeds."""
+    visible = dict(visible)
+    n = 0
+    why = None
+    for st in steps:
+        if st.stage_bound:
+            why = "host_stage"
+            break
+        infos: Dict[str, ColumnInfo] = {}
+        bad = None
+        for name in st.program.input_names:
+            col = st.program.column_for_input(name)
+            ci = visible.get(col)
+            if ci is None:
+                bad = col
+                break
+            infos[name] = ci
+        if bad is not None:
+            why = f"column {bad!r} is host-only/ragged or absent"
+            break
+        try:
+            outs = _analyzed_outputs_cached(
+                st.program, infos, cell=st.kind == "map_rows"
+            )
+        except Exception as e:  # analysis failure: run the stage eagerly
+            why = f"shape inference failed ({type(e).__name__})"
+            break
+        if st.trim:
+            visible = dict(outs)
+        else:
+            visible.update(outs)
+        n += 1
+    return n, why, visible
+
+
+class _FusedMeta:
+    """One fused group's compile-time facts: the chain's staged entry
+    columns (pruned), final fetches, per-stage bucket-proof specs,
+    per-stage liveness (columns still needed after each stage — the
+    donation/free analysis), and the composed ANALYSIS program the
+    roofline decision probes (never executed — execution applies the
+    stage programs' own entries)."""
+
+    __slots__ = (
+        "program",
+        "fetches",
+        "src_inputs",
+        "pruned",
+        "trim",
+        "steps",
+        "param_slots",
+        "stage_specs",
+        "live_after",
+    )
+
+
+# Fusion metadata is cached process-wide so re-running a rebuilt chain
+# (same stage Programs, same entry layout) skips re-analysis and reuses
+# one probe program.  Keys hold id()s; entries carry weakrefs so a
+# recycled id can never alias stale metadata onto different programs.
+_FUSED_CACHE: "collections.OrderedDict[Any, Tuple[Any, _FusedMeta]]" = (
+    collections.OrderedDict()
+)
+_FUSED_CACHE_CAP = 64
+
+
+def _entry_signature(frame: TensorFrame) -> Tuple:
+    sig = []
+    for c in frame.columns:
+        if c.info.scalar_type.device_ok and not c.is_ragged:
+            sig.append(
+                (c.info.name, tuple(c.data.shape[1:]), str(c.data.dtype))
+            )
+    return tuple(sorted(sig))
+
+
+def _compose(steps: Sequence[PlanStep], frame: TensorFrame) -> _FusedMeta:
+    """Analyse ``steps`` as one fused chain over ``frame``'s entry
+    columns (cached): which source columns the chain consumes (its
+    pruned staging set), what it produces, the per-stage specs the
+    bucket-padding proof needs, and a composed probe Program whose
+    compiled HLO feeds the pool/serial cost decision."""
+    key = (
+        tuple((st.kind, id(st.program), st.trim) for st in steps),
+        _entry_signature(frame),
+    )
+    hit = _FUSED_CACHE.get(key)
+    if hit is not None:
+        refs, meta = hit
+        if all(r() is st.program for r, st in zip(refs, steps)):
+            _FUSED_CACHE.move_to_end(key)
+            _sync_probe_params(meta)
+            return meta
+        del _FUSED_CACHE[key]
+
+    import jax
+
+    src_infos = _device_infos(frame)
+    origin: Dict[str, str] = {n: "source" for n in src_infos}
+    infos_now: Dict[str, ColumnInfo] = dict(src_infos)
+    src_inputs: List[str] = []
+    param_slots: List[Tuple[str, Program]] = []  # (param name, owner)
+    stage_specs: List[Optional[Dict[str, Any]]] = []
+    for st in steps:
+        step_infos: Dict[str, ColumnInfo] = {}
+        for name in st.program.input_names:
+            col = st.program.column_for_input(name)
+            if col not in origin:
+                raise ValidationError(
+                    f"plan.{st.label}: program input {name!r} requests "
+                    f"column {col!r}, which is not available at this "
+                    f"point in the chain. Available: {sorted(origin)}."
+                )
+            if origin[col] == "source" and col not in src_inputs:
+                src_inputs.append(col)
+            step_infos[name] = infos_now[col]
+        # (2, *cell) probe specs for the row-independence proof behind
+        # bucket padding — None when a cell dim is Unknown at this stage
+        specs: Optional[Dict[str, Any]] = {}
+        for name, ci in step_infos.items():
+            cell = tuple(ci.cell_shape)
+            if any(d == UNKNOWN for d in cell):
+                specs = None
+                break
+            specs[name] = jax.ShapeDtypeStruct(
+                (2,) + cell, dtypes.coerce(ci.scalar_type).np_dtype
+            )
+        stage_specs.append(specs)
+        outs = _analyzed_outputs_cached(
+            st.program, step_infos, cell=st.kind == "map_rows"
+        )
+        if st.trim:
+            origin = {n: "derived" for n in outs}
+            infos_now = dict(outs)
+        else:
+            origin.update({n: "derived" for n in outs})
+            infos_now.update(outs)
+        for p in st.program.param_names:
+            if all(p != q for q, _ in param_slots):
+                param_slots.append((p, st.program))
+    fetches = sorted(n for n, kind in origin.items() if kind == "derived")
+    if not fetches:
+        raise ValidationError(
+            "plan: the fused chain produces no derived outputs"
+        )
+    pruned = sorted(set(src_infos) - set(src_inputs))
+    trim = any(st.trim for st in steps)
+
+    steps_t = tuple(steps)
+    stage_params = tuple(tuple(st.program.param_names) for st in steps_t)
+
+    def probe(**kw):
+        # ANALYSIS-ONLY composed body (roofline cost probe): the real
+        # execution applies each stage's own compiled entry so fused
+        # rounding is bit-identical to eager (see module docstring)
+        import jax as _jax
+
+        blk: Dict[str, Any] = {c: kw[c] for c in src_inputs}
+        for st, pnames in zip(steps_t, stage_params):
+            prog = st.program
+            params = {p: kw[p] for p in pnames}
+            inputs = {
+                n: blk[prog.column_for_input(n)] for n in prog.input_names
+            }
+            if st.kind == "map_rows":
+                outs = _jax.vmap(
+                    lambda ins, _p=params, _pr=prog: _pr.call(ins, _p),
+                    in_axes=(0,),
+                )(inputs)
+            else:
+                outs = prog.call(inputs, params)
+            blk = dict(outs) if st.trim else {**blk, **outs}
+        return {f: blk[f] for f in fetches}
+
+    merged_params = {p: owner._params[p] for p, owner in param_slots}
+    program = Program(
+        probe,
+        list(src_inputs) + [p for p, _ in param_slots],
+        fetches=fetches,
+        params=merged_params,
+    )
+
+    # liveness: columns still needed AFTER stage k (later stages'
+    # inputs + the final fetches) — drives both the dead-buffer frees
+    # between stages and the donation eligibility below
+    live = set(fetches)
+    live_after: List[Set[str]] = [set() for _ in steps_t]
+    for k in range(len(steps_t) - 1, -1, -1):
+        live_after[k] = set(live)
+        live |= {
+            steps_t[k].program.column_for_input(n)
+            for n in steps_t[k].program.input_names
+        }
+
+    meta = _FusedMeta()
+    meta.program = program
+    meta.fetches = fetches
+    meta.src_inputs = list(src_inputs)
+    meta.pruned = pruned
+    meta.trim = trim
+    meta.steps = steps_t
+    meta.param_slots = tuple(param_slots)
+    meta.stage_specs = stage_specs
+    meta.live_after = live_after
+    refs = tuple(weakref.ref(st.program) for st in steps_t)
+    _FUSED_CACHE[key] = (refs, meta)
+    while len(_FUSED_CACHE) > _FUSED_CACHE_CAP:
+        _FUSED_CACHE.popitem(last=False)
+    return meta
+
+
+def _sync_probe_params(meta: _FusedMeta) -> None:
+    """Keep the probe program's params tracking the live stage params
+    (shape-stable by ``update_params``' contract), so its cost analysis
+    and cached specs never go stale.  Execution always reads the stage
+    programs' own live params via their compiled entries."""
+    for p, owner in meta.param_slots:
+        live = owner._params.get(p)
+        if live is not None and meta.program._params.get(p) is not live:
+            meta.program._params[p] = live
+
+
+# ---------------------------------------------------------------------------
+# pool-vs-serial decision (roofline + retrace state)
+# ---------------------------------------------------------------------------
+
+
+def _fused_intensity(
+    program: Program, frame: TensorFrame
+) -> Optional[float]:
+    """Arithmetic intensity (flops/byte) of the fused chain at this
+    frame's largest (bucketed) block signature, from the XLA cost model
+    ``roofline._aggregate_cost`` reads — memoized on the probe program,
+    so it compiles once per signature."""
+    import jax
+
+    rows = max(frame.block_sizes or [0])
+    if rows <= 0:
+        return None
+    if bucketing.enabled():
+        rows = bucketing.bucket_for(rows)
+    specs = {}
+    for n in program.input_names:
+        col = frame.column(n)
+        cell = tuple(np.shape(col.data)[1:])
+        st = dtypes.coerce(col.info.scalar_type)
+        specs[n] = jax.ShapeDtypeStruct((rows,) + cell, st.np_dtype)
+    sig = tuple(
+        (n, specs[n].shape, str(specs[n].dtype)) for n in sorted(specs)
+    )
+    key = ("plan-intensity", sig)
+    if key in program._derived:
+        return program._derived_hit(key)
+    try:
+        param_specs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+            program._params,
+        )
+        with observability.suppress_trace_count():
+            compiled = program._jit_raw().lower(specs, param_specs).compile()
+        flops, nbytes = _roofline._aggregate_cost(compiled)
+        intensity = (
+            float(flops) / float(nbytes) if flops and nbytes else None
+        )
+    except Exception:  # noqa: BLE001 - the decision degrades, never fails
+        intensity = None
+    while len(program._derived) >= program._DERIVED_CAP:
+        program._derived.pop(next(iter(program._derived)))
+    program._derived[key] = intensity
+    return intensity
+
+
+def _chain_warm(steps: Sequence[PlanStep]) -> bool:
+    """Whether every stage's compiled entry already exists (traced by a
+    prior planned run OR by the eager verbs — the caches are shared):
+    pooling a warm chain costs no first-dispatch compiles."""
+    for st in steps:
+        prog = st.program
+        if st.kind == "map_rows":
+            if prog._vmapped is None:
+                return False
+        elif prog._jitted is None:
+            return False
+    return True
+
+
+def _choose_dispatch(
+    meta: _FusedMeta, frame: TensorFrame, warm: bool
+) -> Dict[str, Any]:
+    """The per-group dispatch decision record: ``affinity`` (sharded
+    cache resident), ``pool`` (warm executables, or compute-bound per
+    the roofline cost model), or ``serial`` (pool unavailable, or a
+    cold transfer-bound chain where device-resident serial chaining
+    beats paying one compile per device)."""
+    rec: Dict[str, Any] = {"warm": bool(warm)}
+    if frame_cache.active_cache(frame) is not None:
+        rec.update(decision="affinity", reason="sharded_cache_resident")
+        return rec
+    devs = device_pool.pool_devices()
+    rec["devices"] = len(devs)
+    if (
+        len(devs) < 2
+        or frame.num_blocks < 2
+        or frame.num_rows == 0
+        or not _DEFAULT._frame_fresh(frame)
+    ):
+        rec.update(decision="serial", reason="pool_unavailable")
+        return rec
+    # blocks past the engine's chunked-streaming threshold must keep
+    # the serial per-stage dispatch: there _stream_plan ingests them
+    # chunk-by-chunk with bounded HBM and OOM-split handling, a
+    # contract the pooled chain's whole-block device_put would bypass
+    chunk = _DEFAULT.stream_chunk_bytes
+    if chunk:
+        per_row = 0
+        for name in meta.src_inputs:
+            col = frame.column(name)
+            cell = tuple(np.shape(col.data)[1:])
+            st = dtypes.coerce(col.info.scalar_type)
+            per_row += int(np.prod(cell, dtype=np.int64)) * np.dtype(
+                st.np_dtype
+            ).itemsize
+        if max(frame.block_sizes) * per_row >= 2 * chunk:
+            rec.update(decision="serial", reason="stream_chunked_blocks")
+            return rec
+    if warm:
+        rec.update(decision="pool", reason="warm_executables")
+        return rec
+    intensity = _fused_intensity(meta.program, frame)
+    rec["intensity_flops_per_byte"] = (
+        round(intensity, 4) if intensity is not None else None
+    )
+    threshold = pool_min_intensity()
+    rec["threshold"] = threshold
+    if intensity is None or intensity >= threshold:
+        rec.update(
+            decision="pool",
+            reason="no_cost_model" if intensity is None else "compute_bound",
+        )
+        return rec
+    rec.update(decision="serial", reason="transfer_bound_cold")
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# fused-chain execution
+# ---------------------------------------------------------------------------
+
+
+def _apply_stages(
+    meta: _FusedMeta, staged: Dict[str, Any], donate_entries: bool
+) -> Dict[str, Any]:
+    """Apply the chain's stages to ONE block's staged inputs via each
+    stage program's OWN compiled entry (``jitted``/``vmapped`` — the
+    executables the eager verbs run, live params bound), keeping every
+    intermediate on the block's device.  Shape hints are re-checked per
+    stage exactly like the eager dispatch.
+
+    HBM discipline mirrors the eager pooled loop's: buffers no later
+    stage (nor the fetches) reads are DROPPED after each stage, and a
+    stage whose every input is a fresh buffer (this call's staged
+    entries when ``donate_entries`` — never shards — or an earlier
+    stage's intermediate) that dies at this stage runs through the
+    engine's DONATING entry, so XLA reuses the input memory for the
+    outputs exactly like ``_block_run(program, donate=True)`` does for
+    the eager verbs.  Retries are safe by the existing contract: every
+    attempt past the first re-stages fresh buffers."""
+    donate_ok = prefetch.donate_inputs()
+    blk = dict(staged)
+    # fresh[c]: buffer c may be donated (created by/for this call only)
+    fresh = {c: donate_entries for c in blk}
+    for k, st in enumerate(meta.steps):
+        prog = st.program
+        cols = [prog.column_for_input(n) for n in prog.input_names]
+        inputs = {n: blk[c] for n, c in zip(prog.input_names, cols)}
+        live = meta.live_after[k]
+        donate = (
+            donate_ok
+            and all(fresh.get(c, False) for c in cols)
+            and not (set(cols) & live)
+        )
+        if st.kind == "map_rows":
+            outs = _DEFAULT._rows_run(prog, donate)(inputs)
+        else:
+            outs = _DEFAULT._block_run(prog, donate)(inputs)
+        del inputs
+        _check_shape_hints(
+            prog, outs, f"plan.{st.label}", cell_level=st.kind == "map_rows"
+        )
+        if st.trim:
+            blk = dict(outs)
+            fresh = {}
+        else:
+            blk.update(outs)
+            # free buffers nothing downstream reads (donated ones are
+            # dead already; the rest would otherwise pin HBM until the
+            # chain ends)
+            blk = {c: v for c, v in blk.items() if c in live}
+            fresh = {c: f for c, f in fresh.items() if c in live}
+        fresh.update({c: True for c in outs})
+    return {f: blk[f] for f in meta.fetches}
+
+
+def _check_chain_outputs(
+    meta: _FusedMeta, outs: Dict[str, Any], n_rows: int
+) -> None:
+    if not meta.trim:
+        for name, v in outs.items():
+            if v.ndim == 0 or v.shape[0] != n_rows:
+                raise ValidationError(
+                    f"plan: fused output {name!r} has shape {v.shape} but "
+                    f"the input block has {n_rows} rows; a non-trimmed "
+                    f"chain must preserve the row count."
+                )
+    else:
+        counts = {v.shape[0] if v.ndim else None for v in outs.values()}
+        if len(counts) != 1 or None in counts:
+            raise ValidationError(
+                f"plan: trimmed chain outputs disagree on row count: "
+                f"{ {k: v.shape for k, v in outs.items()} }"
+            )
+
+
+def _chain_pads(
+    meta: _FusedMeta, frame: TensorFrame
+) -> List[Optional[int]]:
+    """Bucket targets for the pooled chain (the engine's
+    ``_bucket_plan`` analog): pad each block's entry to its bucket so
+    one executable per stage serves every block size — gated on EVERY
+    block-level stage passing the jaxpr row-independence proof at the
+    exact (real, padded) sizes (map_rows stages are independent by
+    construction).  Trimmed chains keep exact shapes (program-defined
+    output row counts cannot slice back)."""
+    nb = frame.num_blocks
+    none: List[Optional[int]] = [None] * nb
+    if meta.trim or not bucketing.enabled():
+        return none
+    sizes = frame.block_sizes
+    targets = [
+        bucketing.bucket_for(s) if s > 0 else None for s in sizes
+    ]
+    targets = [
+        t if t is not None and t != sizes[i] else None
+        for i, t in enumerate(targets)
+    ]
+    if all(t is None for t in targets):
+        return none
+    proof_sizes = sorted(
+        {sizes[i] for i, t in enumerate(targets) if t is not None}
+        | {t for t in targets if t is not None}
+    )
+    for st, specs in zip(meta.steps, meta.stage_specs):
+        if st.kind == "map_rows":
+            continue
+        if specs is None or not segment_compile.cached_rows_independent(
+            st.program, specs, proof_sizes
+        ):
+            return none
+    return targets
+
+
+def _run_serial_chain(
+    steps: Sequence[PlanStep], frame: TensorFrame
+) -> TensorFrame:
+    """The fused-serial leg: stages dispatch through the pool-opted-out
+    engine — device-resident chaining, only the first stage's inputs
+    ever stage H2D, every engine contract (bucketing, streaming,
+    donation, retries, empty frames) byte-identical to the eager serial
+    path because it IS that path."""
+    cur = frame
+    for st in steps:
+        if st.kind == "map_rows":
+            cur = _SERIAL.map_rows(st.program, cur, host_stage=st.host_stage)
+        else:
+            cur = _SERIAL.map_blocks(
+                st.program, cur, trim=st.trim, host_stage=st.host_stage
+            )
+    return cur
+
+
+def _run_pooled_chain(
+    meta: _FusedMeta,
+    frame: TensorFrame,
+    cache,
+    devices: Sequence[Any],
+) -> Tuple[TensorFrame, Dict[str, Any]]:
+    """The pooled fused chain: each block stages ONCE (pruned entry
+    columns, per-device staging lanes — or resident shards when the
+    entry frame is sharded-cached), the whole stage chain runs on the
+    block's device, and one overlapped readback window assembles the
+    final outputs — the planner's replacement for per-verb pooling's
+    host-assembly + re-staging between links.
+
+    Fault tolerance mirrors the engine's pooled loops: retries re-stage
+    fresh host buffers on the current effective device and re-run the
+    chain; quarantine redirects follow ``PoolRun``.  Outputs are
+    donation-adopted as the result frame's shards when sharding
+    resolves, with a GC finalizer releasing the budget."""
+    import jax
+
+    sizes = frame.block_sizes
+    nb = frame.num_blocks
+    offsets = frame.offsets
+    assignment = (
+        list(cache.assignment)
+        if cache is not None
+        else device_pool.assign(sizes, len(devices))
+    )
+    pool = device_pool.PoolRun(
+        devices,
+        assignment,
+        prefetch.prefetch_depth() or 1,
+        affinity=cache is not None,
+    )
+    session = fault_tolerance.frame_session(nb, verb="plan", pool=pool)
+    pads = _chain_pads(meta, frame)
+    np_dtypes: Dict[str, Any] = {}
+    host_cols: Dict[str, np.ndarray] = {}
+    for name in meta.src_inputs:
+        col = frame.column(name)
+        np_dtypes[name] = dtypes.coerce(col.info.scalar_type).np_dtype
+        host_cols[name] = np.asarray(col.data)
+
+    def stage_block(bi, dev):
+        lo, hi = offsets[bi], offsets[bi + 1]
+        staged = {}
+        for name in meta.src_inputs:
+            a = host_cols[name][lo:hi]
+            if a.dtype != np_dtypes[name]:
+                a = a.astype(np_dtypes[name])
+            if pads[bi] is not None:
+                a = bucketing.pad_rows(a, pads[bi])
+            observability.note_h2d_bytes(a.nbytes)
+            staged[name] = jax.device_put(a, dev)
+        return staged
+
+    def stage_cached(bi, dev_i):
+        """Entry dict for one sharded-cached block: resident shard
+        columns pass through on their device; missing columns and
+        evicted blocks re-stage from the authoritative host copy."""
+        shard = cache.shard(bi) if dev_i == assignment[bi] else None
+        lo, hi = offsets[bi], offsets[bi + 1]
+        staged = {}
+        used = False
+        for name in meta.src_inputs:
+            v = shard.get(name) if shard is not None else None
+            if v is not None:
+                if pads[bi] is not None:
+                    v = bucketing.pad_rows(v, pads[bi])
+                staged[name] = v
+                used = True
+                continue
+            a = host_cols[name][lo:hi]
+            if a.dtype != np_dtypes[name]:
+                a = a.astype(np_dtypes[name])
+            if pads[bi] is not None:
+                a = bucketing.pad_rows(a, pads[bi])
+            observability.note_h2d_bytes(a.nbytes)
+            staged[name] = jax.device_put(a, devices[dev_i])
+        return staged, used
+
+    if cache is None:
+        lanes = device_pool.lanes(
+            devices, assignment, stage_block, name="tfs-plan"
+        )
+        lane_iters = [iter(ln) for ln in lanes]
+        lane_dead = [False] * len(devices)
+    else:
+        lanes = []
+    out_blocks: List[Optional[Dict[str, Any]]] = [None] * nb
+    adopt_outs = (
+        [None] * nb
+        if (cache is not None or len(frame_cache.shard_devices(None)) >= 2)
+        else None
+    )
+    eff_assign: List[int] = []
+    shard_hits = 0
+    for bi in range(nb):
+        cancellation.checkpoint()  # block boundary (pooled chain)
+        t_blk = observability.trace_now()  # flight recorder
+        di = assignment[bi]
+        if cache is not None:
+            di_eff = pool.effective_device(di) if session else di
+            staged, used = (
+                stage_cached(bi, di_eff)
+                if (session is None or di_eff == di)
+                else (None, False)
+            )
+            if used:
+                shard_hits += 1
+                observability.note_cache_shard_hit()
+            elif session is not None and di_eff != di:
+                session.note_cache_restage()
+        elif session is None:
+            staged = next(lane_iters[di])
+        else:
+            staged = _DEFAULT._lane_next(
+                lane_iters[di], lane_dead, di, session, pool
+            )
+        if session is None:
+            # entry buffers donate only when freshly staged this call
+            # (never resident shards — they are shared frame state)
+            outs = _apply_stages(meta, staged, donate_entries=cache is None)
+            del staged
+            di_eff = di
+        else:
+            holder = {"v": staged}
+            del staged
+
+            def attempt(a, dev_i, _bi=bi, _h=holder, _di=di):
+                # attempt 0 may consume the staged entry; every retry
+                # (and any quarantine redirect) re-stages fresh host
+                # buffers on the CURRENT device and re-runs the chain
+                ins = _h.pop("v", None) if (a == 0 and dev_i == _di) else None
+                _h.clear()
+                restaged = ins is None
+                if ins is None:
+                    ins = stage_block(_bi, devices[dev_i])
+                # re-staged buffers are fresh even for cached frames;
+                # attempt-0 entries are fresh only without a cache
+                return _apply_stages(
+                    meta, ins, donate_entries=restaged or cache is None
+                )
+
+            outs = session.run(
+                bi,
+                sizes[bi],
+                attempt,
+                device=lambda _di=di: pool.effective_device(_di),
+            )
+            di_eff = pool.effective_device(di)
+        if pads[bi] is not None:
+            # bucket-padded chain: slice the pad rows back off (the
+            # per-stage proofs guarantee real rows' values)
+            outs = {k: v[: sizes[bi]] for k, v in outs.items()}
+        _check_chain_outputs(meta, outs, sizes[bi])
+        if adopt_outs is not None:
+            adopt_outs[bi] = outs
+        eff_assign.append(di_eff)
+        pool.submit(bi, di_eff, sizes[bi], outs, out_blocks)
+        observability.trace_complete(
+            f"plan b{bi}", f"device/{di_eff}", t_blk,
+            block=bi, rows=sizes[bi],
+        )
+    pool.finish(out_blocks)
+    out_frame = TensorFrame.from_blocks(out_blocks)
+    if not meta.trim:
+        # source columns not shadowed by chain outputs pass through
+        # unchanged — including the PRUNED ones, host-side, zero staging
+        extra = [
+            c
+            for c in frame.columns
+            if c.info.name not in out_frame.column_names
+        ]
+        if extra:
+            out_frame = TensorFrame(
+                list(out_frame.columns) + extra, out_frame.offsets
+            )
+    rec: Dict[str, Any] = {
+        "device_pool": pool.record(
+            sum(ln.stats["stage_s"] for ln in lanes),
+            sum(ln.stats["wait_s"] for ln in lanes),
+        )
+    }
+    if cache is not None:
+        fc = cache.record()
+        fc["shard_hits"] = shard_hits
+        rec["frame_cache"] = fc
+    if session is not None and session.events():
+        rec["fault_tolerance"] = session.record()
+    adopted = (
+        frame_cache.adopt(out_frame, devices, eff_assign, adopt_outs)
+        if adopt_outs is not None
+        else None
+    )
+    if adopted is not None:
+        # planner-created cache: refund the HBM budget at frame GC
+        weakref.finalize(out_frame, _release_cache, adopted)
+        observability.note_plan_cache_insert()
+        rec["adopted_blocks"] = adopted.resident_blocks()
+    return out_frame, rec
+
+
+# ---------------------------------------------------------------------------
+# the lazy frame
+# ---------------------------------------------------------------------------
+
+
+class LazyFrame:
+    """A frame whose verbs build a logical plan (``frame.lazy()``).
+
+    Nodes form a DAG: each derived LazyFrame holds its parent strongly
+    (the plan must survive) and parents hold children weakly (consumer
+    bookkeeping must not leak).  Materialisation memoizes the executed
+    frame on the node, so a shared subplan executes once; a node with
+    two or more consumers becomes an optimization *barrier* and — when a
+    device pool is available — gets an auto-inserted sharded cache over
+    the columns its consumers read.
+
+    Any TensorFrame attribute not defined here (``collect``,
+    ``to_arrays``, ``column``, ``schema``, …) materialises the plan and
+    delegates — the lazy surface is a superset of the eager one."""
+
+    _tfs_lazy = True
+
+    def __init__(
+        self,
+        source: Optional[TensorFrame] = None,
+        parent: Optional["LazyFrame"] = None,
+        step: Optional[PlanStep] = None,
+    ):
+        if (source is None) == (parent is None):
+            raise ValidationError(
+                "LazyFrame: exactly one of source/parent is required"
+            )
+        self._source = source
+        self._parent = parent
+        self._step = step
+        self._child_refs: List[Any] = []
+        self._children = 0  # registered consumers (derived + terminal)
+        self._materialized: Optional[TensorFrame] = (
+            source if step is None else None
+        )
+        self._mat_uses = 0  # dispatch-consumptions of the memoized frame
+        self._auto_cached = False
+        self._finalizer = None
+        self._last_records: List[Dict[str, Any]] = []
+        self._runs = 0  # times this node's step has executed
+
+    # -- plan building -------------------------------------------------------
+
+    def lazy(self) -> "LazyFrame":
+        return self
+
+    def _append(
+        self,
+        kind: str,
+        program: Program,
+        trim: bool = False,
+        host_stage: Optional[Mapping[str, Any]] = None,
+    ) -> "LazyFrame":
+        step = PlanStep(kind, program, trim=trim, host_stage=host_stage)
+        child = LazyFrame(parent=self, step=step)
+        if len(self._child_refs) >= 32:
+            # epochs loops re-derive from one shared root every pass:
+            # drop dead consumer refs so the list stays bounded by the
+            # LIVE fan-out, not the plan's lifetime
+            self._child_refs = [
+                r for r in self._child_refs if r() is not None
+            ]
+        self._child_refs.append(weakref.ref(child))
+        self._children += 1
+        return child
+
+    def group_by(self, *keys: str) -> GroupedFrame:
+        """Materialise and group — ``aggregate`` is a materialisation
+        point (its group structure is data-dependent)."""
+        self._children += 1
+        mat = self._materialize(needed_hint=set(keys))
+        return GroupedFrame(mat, keys)
+
+    def frame(self) -> TensorFrame:
+        """Force execution and return the materialised TensorFrame."""
+        return self._materialize(count_use=False)
+
+    # -- execution -----------------------------------------------------------
+
+    def _materialize(
+        self,
+        needed_hint: Optional[Set[str]] = None,
+        count_use: bool = True,
+    ) -> TensorFrame:
+        if self._materialized is not None:
+            if count_use:
+                self._mat_uses += 1
+                if self._mat_uses >= 2:
+                    self._ensure_auto_cache(needed_hint)
+            return self._materialized
+
+        # the chain of unmaterialised steps back to the nearest memo/root
+        chain: List[LazyFrame] = []
+        cur = self
+        while cur._materialized is None:
+            chain.append(cur)
+            cur = cur._parent
+        chain.reverse()
+        entry = cur
+        frame = entry._materialized
+        # one more dispatch reads the shared entry: promote it to an
+        # auto cache on its second consumption (the epochs pattern)
+        entry._mat_uses += 1
+        if entry._mat_uses >= 2:
+            entry._ensure_auto_cache(_first_step_cols(chain) or needed_hint)
+
+        records: List[Dict[str, Any]] = []
+        with observability.verb_span(
+            "plan", frame.num_rows, frame.num_blocks
+        ) as span:
+            pending: List[LazyFrame] = []
+            done = 0
+            for nd in chain:
+                pending.append(nd)
+                if nd._children >= 2 and nd is not chain[-1]:
+                    # shared subplan: materialisation barrier + cache
+                    frame = _flush(pending, frame, records, done)
+                    done += len(pending)
+                    pending = []
+                    nd._materialized = frame
+                    nd._mat_uses = 1
+                    nd._ensure_auto_cache(None)
+                    frame = nd._materialized
+            if pending:
+                frame = _flush(pending, frame, records, done)
+            span.annotate(
+                "planner",
+                {
+                    "stages": records,
+                    "fused_groups": sum(
+                        1 for r in records if r.get("fused", 0) >= 2
+                    ),
+                    "pruned_columns": sorted(
+                        {c for r in records for c in r.get("pruned", ())}
+                    ),
+                },
+            )
+        self._materialized = frame
+        self._mat_uses = 1
+        self._last_records = records
+        return frame
+
+    # -- auto cache ----------------------------------------------------------
+
+    def _ensure_auto_cache(
+        self, needed_hint: Optional[Set[str]] = None
+    ) -> None:
+        """Insert the sharded cache on this node's materialised frame,
+        over the columns downstream consumers read — once, and only when
+        shard placement resolves (>= 2 pool devices per
+        ``TFS_CACHE_SHARDED``'s auto rule, exactly like ``cache()``'s
+        default).  A ``weakref.finalize`` on the frame releases the
+        shards when the planned frame is garbage-collected, refunding
+        ``TFS_HBM_BUDGET`` deterministically instead of waiting for a
+        later charge walk to prune the dead entries."""
+        mat = self._materialized
+        if mat is None or self._auto_cached:
+            return
+        if frame_cache.active_cache(mat) is not None:
+            self._auto_cached = True  # adopted / user-cached already
+            return
+        devs = frame_cache.shard_devices(None)
+        if len(devs) < 2:
+            return
+        needed, everything = self._needed_below()
+        if needed_hint:
+            needed |= set(needed_hint)
+        cacheable = [
+            name
+            for name in _device_infos(mat)
+            if not mat.column(name).is_device
+            and (everything or name in needed)
+        ]
+        if not cacheable:
+            return
+        cache = frame_cache.build(mat, sorted(cacheable), devices=devs)
+        if cache is None:
+            return
+        frame_cache.attach(mat, cache)
+        self._finalizer = weakref.finalize(mat, _release_cache, cache)
+        self._auto_cached = True
+        observability.note_plan_cache_insert()
+        _log.info(
+            "planner: auto-inserted sharded cache over %s (%d consumers)",
+            cacheable,
+            max(self._children, self._mat_uses),
+        )
+
+    def _needed_below(self) -> Tuple[Set[str], bool]:
+        """Columns of this node's frame that registered downstream
+        stages consume (transitively), plus an everything flag when a
+        host-staged descendant makes the set unknowable.
+        Over-approximation is safe: the host copy stays authoritative,
+        extra shards are only bytes."""
+        needed: Set[str] = set()
+        everything = False
+        for ref in self._child_refs:
+            child = ref()
+            if child is None or child._step is None:
+                continue
+            st = child._step
+            if st.stage_bound:
+                everything = True
+            needed.update(
+                st.program.column_for_input(n)
+                for n in st.program.input_names
+            )
+            sub, all_flag = child._needed_below()
+            needed |= sub
+            everything = everything or all_flag
+        return needed, everything
+
+    # -- terminal verbs ------------------------------------------------------
+
+    def _reduce(self, verb: str, program: Program, mode: str = "tree"):
+        self._children += 1
+        mat = self._materialize(needed_hint=_reduce_cols(program))
+        if verb == "reduce_rows":
+            return _DEFAULT.reduce_rows(program, mat, mode=mode)
+        return _DEFAULT.reduce_blocks(program, mat)
+
+    # -- surface -------------------------------------------------------------
+
+    @property
+    def is_materialized(self) -> bool:
+        return self._materialized is not None
+
+    def explain_plan(self) -> str:
+        return explain_plan(self)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._materialize(count_use=False), name)
+
+    def __repr__(self):
+        return self.explain_plan()
+
+
+def _release_cache(cache) -> None:
+    """``weakref.finalize`` body for planner-created caches: drop the
+    shards and refund the HBM budget at frame GC."""
+    cache.release()
+
+
+def _first_step_cols(chain: Sequence[LazyFrame]) -> Optional[Set[str]]:
+    if not chain:
+        return None
+    st = chain[0]._step
+    return {st.program.column_for_input(n) for n in st.program.input_names}
+
+
+def _reduce_cols(program: Program) -> Set[str]:
+    """Frame columns a reduce program will consume — the auto-cache
+    hint.  Feed-dict renames resolve to the fed column; unrenamed inputs
+    strip the reduce suffix (``x_input`` / ``x_1`` / ``x_2`` -> ``x``)."""
+    cols: Set[str] = set()
+    for n in program.input_names:
+        col = program.column_for_input(n)
+        if col != n:
+            cols.add(col)
+            continue
+        for suf in ("_input", "_1", "_2"):
+            if n.endswith(suf):
+                cols.add(n[: -len(suf)])
+                break
+        else:
+            cols.add(n)
+    return cols
+
+
+# ---------------------------------------------------------------------------
+# group dispatch
+# ---------------------------------------------------------------------------
+
+
+def _flush(
+    nodes: List[LazyFrame],
+    frame: TensorFrame,
+    records: List[Dict],
+    start_idx: int,
+) -> TensorFrame:
+    """Execute ``nodes``' steps over ``frame``: maximal fusable runs
+    dispatch as ONE chained pass; everything else (host-staged,
+    ragged-input, lone stages) runs the plain eager verb — the same
+    dispatch the eager path would make."""
+    i = 0
+    while i < len(nodes):
+        steps = [nd._step for nd in nodes[i:]]
+        n, why, _ = _fusable_run(steps, _device_infos(frame))
+        if n >= 2:
+            frame = _dispatch_fused(
+                nodes[i : i + n], frame, records, start_idx + i
+            )
+            i += n
+        else:
+            frame = _dispatch_single(
+                nodes[i],
+                frame,
+                records,
+                start_idx + i,
+                why if n == 0 else "single_stage",
+            )
+            i += 1
+    return frame
+
+
+def _dispatch_single(
+    node: LazyFrame,
+    frame: TensorFrame,
+    records: List[Dict],
+    idx: int,
+    reason: str,
+) -> TensorFrame:
+    st = node._step
+    if st.kind == "map_rows":
+        out = _DEFAULT.map_rows(st.program, frame, host_stage=st.host_stage)
+    else:
+        out = _DEFAULT.map_blocks(
+            st.program, frame, trim=st.trim, host_stage=st.host_stage
+        )
+    node._runs += 1
+    records.append(
+        {
+            "stage": idx,
+            "verb": st.label,
+            "fused": 1,
+            "dispatch": "eager",
+            "reason": reason,
+        }
+    )
+    return out
+
+
+def _dispatch_fused(
+    group: List[LazyFrame],
+    frame: TensorFrame,
+    records: List[Dict],
+    idx: int,
+) -> TensorFrame:
+    steps = [nd._step for nd in group]
+    meta = _compose(steps, frame)
+    warm = any(nd._runs > 0 for nd in group) or _chain_warm(steps)
+    rec = _choose_dispatch(meta, frame, warm)
+    decision = rec.pop("decision")
+    reason = rec.pop("reason")
+    if decision in ("pool", "affinity") and frame.num_rows > 0:
+        cache = frame_cache.active_cache(frame)
+        devices = (
+            cache.devices if cache is not None else device_pool.pool_devices()
+        )
+        out, run_rec = _run_pooled_chain(meta, frame, cache, devices)
+        rec.update(run_rec)
+    else:
+        out = _run_serial_chain(steps, frame)
+    observability.note_plan_fused_dispatch()
+    if meta.pruned:
+        observability.note_plan_columns_pruned(len(meta.pruned))
+    records.append(
+        {
+            "stage": idx,
+            "verb": "+".join(st.label for st in steps),
+            "fused": len(group),
+            "dispatch": decision,
+            "reason": reason,
+            "pruned": list(meta.pruned),
+            **rec,
+        }
+    )
+    for nd in group:
+        nd._runs += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# routing + explain
+# ---------------------------------------------------------------------------
+
+
+def root_for(frame: TensorFrame) -> LazyFrame:
+    """The ONE shared plan root for a TensorFrame object (get-or-create)
+    — used by both ``frame.lazy()`` and the ``TFS_PLAN`` routing, so
+    chains built from either entry count as consumers of the same
+    subplan (the auto-cache trigger)."""
+    root = getattr(frame, "_tfs_lazy_root", None)
+    if root is None:
+        root = LazyFrame(source=frame)
+        frame._tfs_lazy_root = root
+    return root
+
+
+def maybe_lazy(frame) -> Optional[LazyFrame]:
+    """The LazyFrame a module-level map verb should append to, or None
+    for the eager path: the frame is already lazy, or ``TFS_PLAN`` is on
+    and the frame is a plain TensorFrame."""
+    if isinstance(frame, LazyFrame):
+        return frame
+    if planning_enabled() and isinstance(frame, TensorFrame):
+        return root_for(frame)
+    return None
+
+
+def ensure_frame(frame):
+    """A concrete TensorFrame for surfaces that cannot stay lazy
+    (pipelines, warmup, the bridge)."""
+    if isinstance(frame, LazyFrame):
+        return frame._materialize(count_use=False)
+    return frame
+
+
+def explain_plan(frame: LazyFrame) -> str:
+    """Render the optimized logical plan WITHOUT executing it: stage
+    list, fused groups (computed by the same grouping walk the executor
+    uses), pruned columns, cache-insertion barriers, and — after a run —
+    the recorded per-group pool/serial decisions."""
+    chain: List[LazyFrame] = []
+    cur = frame
+    while cur._step is not None:
+        chain.append(cur)
+        cur = cur._parent
+    chain.reverse()
+    src = cur._materialized if cur._materialized is not None else cur._source
+    lines = ["== logical plan (lazy) =="]
+    lines.append(
+        f"source: {src.num_rows} rows x {len(src.columns)} cols x "
+        f"{src.num_blocks} block(s) [{', '.join(src.column_names)}]"
+    )
+    if not chain:
+        lines.append("(no stages: materialises to the source frame)")
+        return "\n".join(lines)
+
+    # dry-run grouping: mirror _flush, but threading the statically
+    # inferred visible columns instead of executing.  Barriers (>= 2
+    # consumers) bound fusion exactly like the executor's flush points;
+    # an unfusable host-staged stage makes the schema opaque downstream.
+    gid_of: Dict[int, Tuple[Optional[int], Optional[str]]] = {}
+    visible: Optional[Dict[str, ColumnInfo]] = _device_infos(src)
+    consumed: Set[str] = set()
+    barrier_idx = {k for k, nd in enumerate(chain) if nd._children >= 2}
+    gid = 0
+    i = 0
+    while i < len(chain):
+        stop = next((b for b in sorted(barrier_idx) if b >= i), None)
+        seg_end = len(chain) if stop is None else stop + 1
+        steps = [nd._step for nd in chain[i:seg_end]]
+        if visible is None:
+            n, why, after = 0, "schema opaque after host stage", None
+        else:
+            n, why, after = _fusable_run(steps, visible)
+        if n >= 2:
+            for k in range(i, i + n):
+                gid_of[k] = (gid, None)
+            gid += 1
+            visible = after if n == len(steps) else None
+            i += n
+        else:
+            gid_of[i] = (None, why if n == 0 else "single_stage")
+            visible = None if n == 0 else after
+            i += 1
+    for k, nd in enumerate(chain):
+        st = nd._step
+        g, why = gid_of[k]
+        cols = ", ".join(
+            dict.fromkeys(
+                st.program.column_for_input(n)
+                for n in st.program.input_names
+            )
+        )
+        consumed.update(
+            st.program.column_for_input(n) for n in st.program.input_names
+        )
+        tag = f"fused group {g}" if g is not None else f"eager ({why})"
+        mark = (
+            "  [barrier: >=2 consumers -> auto-cache]"
+            if k in barrier_idx
+            else ""
+        )
+        lines.append(
+            f" stage {k:<2} {st.label:<20} reads [{cols}]  {tag}{mark}"
+        )
+    dead = sorted(set(_device_infos(src)) - consumed)
+    lines.append(
+        "pruned columns (never staged by fused groups): "
+        + (", ".join(dead) if dead else "none")
+    )
+    inserted = [
+        f"stage {k} (inserted)"
+        for k, nd in enumerate(chain)
+        if nd._auto_cached
+    ]
+    pendings = [
+        f"stage {k} ({chain[k]._children} consumers)"
+        for k in sorted(barrier_idx)
+        if not chain[k]._auto_cached
+    ]
+    lines.append(
+        "cache insertions: "
+        + (", ".join(inserted + pendings) if (inserted or pendings) else "none")
+    )
+    recs = frame._last_records
+    if recs:
+        lines.append("last run:")
+        for r in recs:
+            extra = ""
+            if r.get("intensity_flops_per_byte") is not None:
+                extra = f", intensity={r['intensity_flops_per_byte']}"
+            lines.append(
+                f"  stage {r['stage']}: {r['verb']} -> {r['dispatch']} "
+                f"(reason={r['reason']}{extra})"
+            )
+    return "\n".join(lines)

@@ -1,0 +1,579 @@
+"""Lazy verb-graph planner (``ops/planner.py``, round 14).
+
+The contract under test: ``frame.lazy()`` / ``TFS_PLAN=1`` builds a
+logical plan instead of dispatching, the optimizer fuses adjacent map
+stages into ONE composed-program dispatch (through the regular engine,
+so bucketing / pool / fault tolerance / sharded-cache affinity all
+apply), dead columns are pruned from staging, twice-consumed subplans
+get an auto-inserted sharded cache with a ``weakref.finalize`` uncache,
+and EVERY planned verb is **bit-identical** to its eager counterpart —
+including the uneven-tail bucketed, fault-injection, and pooled legs.
+
+Tests named ``test_pooled_*`` run process-isolated on the forced
+8-device CPU mesh (tests/conftest.py), like the device-pool and
+frame-cache suites; the rest run in-process against the pinned
+single-device baseline (where the planner's pool/cache decisions
+resolve to the serial eager-equivalent paths).
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import observability as obs
+from tensorframes_tpu.ops import frame_cache, planner
+from tensorframes_tpu.ops.validation import ValidationError
+
+# Explicit eager dispatch for the comparison legs: passing engine=
+# bypasses the planner BY DESIGN (a plan targets the default engine), so
+# these tests' eager baselines stay eager even under the planner tier's
+# exported TFS_PLAN=1.
+_EAGER = tfs.Executor()
+
+
+def _frame(n=130, nb=6, seed=0, d=4):
+    """Uneven-tail frame (130 rows over 6 blocks -> 22/22/22/22/21/21)
+    with a dead column no chain consumes and an int key for
+    ``aggregate``."""
+    rng = np.random.RandomState(seed)
+    return tfs.TensorFrame.from_arrays(
+        {
+            "x": rng.rand(n, d).astype(np.float32),
+            "dead": rng.rand(n, d).astype(np.float32),
+            "k": (np.arange(n) % 5).astype(np.int32),
+        },
+        num_blocks=nb,
+    )
+
+
+def _chain_programs():
+    m1 = tfs.Program.wrap(lambda x: {"y": jnp.tanh(x) * 2.0 + x}, fetches=["y"])
+    m2 = tfs.Program.wrap(lambda y: {"z": y * 0.5 + 1.25}, fetches=["z"])
+    return m1, m2
+
+
+def _six_verbs(frame, m1, m2, engine=None):
+    """Chain two fusable maps, then exercise every verb off the chain's
+    tail.  ``frame`` may be a TensorFrame (eager legs pass
+    ``engine=_EAGER`` so they stay eager under TFS_PLAN=1) or a
+    LazyFrame (planned) — the call sites are otherwise identical, which
+    is the point."""
+    a = tfs.map_blocks(m1, frame, engine=engine)
+    b = tfs.map_blocks(m2, a, engine=engine)
+    out = {}
+    out["map_chain_z"] = np.asarray(b.column("z").data)
+    out["map_chain_y"] = np.asarray(b.column("y").data)
+    out["map_chain_dead"] = np.asarray(b.column("dead").data)
+    mr = tfs.Program.wrap(lambda z: {"r": z.sum() + z[0]}, fetches=["r"])
+    out["map_rows"] = np.asarray(
+        tfs.map_rows(mr, b, engine=engine).column("r").data
+    )
+    tr = tfs.Program.wrap(
+        lambda z: {"s": z.sum(0, keepdims=True)}, fetches=["s"]
+    )
+    out["trimmed"] = np.asarray(
+        tfs.map_blocks(tr, b, trim=True, engine=engine).column("s").data
+    )
+    pair = tfs.Program.wrap(
+        lambda z_1, z_2: {"z": z_1 + 3.0 * z_2}, fetches=["z"]
+    )
+    out["reduce_rows_tree"] = tfs.reduce_rows(
+        pair, b, mode="tree", engine=engine
+    )["z"]
+    out["reduce_rows_seq"] = tfs.reduce_rows(
+        pair, b, mode="sequential", engine=engine
+    )["z"]
+    red = tfs.Program.wrap(
+        lambda z_input: {"z": (z_input * 1.3).sum(0)}, fetches=["z"]
+    )
+    out["reduce_blocks"] = tfs.reduce_blocks(red, b, engine=engine)["z"]
+    agg = tfs.Program.wrap(lambda z_input: {"z": z_input.sum(0)}, fetches=["z"])
+    g = tfs.aggregate(agg, tfs.group_by(b, "k"), engine=engine)
+    out["aggregate_k"] = np.asarray(g.column("k").data)
+    out["aggregate_z"] = np.asarray(g.column("z").data)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bit-identity (serial baseline, uneven-tail buckets live by default)
+# ---------------------------------------------------------------------------
+
+
+def test_six_verbs_bit_identical_planned_vs_eager():
+    frame = _frame()
+    m1, m2 = _chain_programs()
+    eager = _six_verbs(frame, m1, m2, engine=_EAGER)
+    planned = _six_verbs(frame.lazy(), m1, m2)
+    assert sorted(eager) == sorted(planned)
+    for name in eager:
+        np.testing.assert_array_equal(
+            eager[name], planned[name], err_msg=f"planned {name}"
+        )
+
+
+def test_six_verbs_bit_identical_under_fault_injection(monkeypatch):
+    """The planned chain under deterministic chaos returns exactly the
+    clean eager bytes — fused dispatches ride the same per-block retry
+    machinery as the eager verbs."""
+    frame = _frame(seed=3)
+    m1, m2 = _chain_programs()
+    eager = _six_verbs(frame, m1, m2, engine=_EAGER)
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", "6")
+    monkeypatch.setenv("TFS_BLOCK_BACKOFF_S", "0.001")
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:rate=0.3:seed=5")
+    chaotic = _six_verbs(frame.lazy(), m1, m2)
+    monkeypatch.setenv("TFS_FAULT_INJECT", "")
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", "0")
+    for name in eager:
+        np.testing.assert_array_equal(
+            eager[name], chaotic[name], err_msg=f"chaos {name}"
+        )
+
+
+def test_trim_chain_drops_passthrough_like_eager():
+    frame = _frame()
+    m1, _ = _chain_programs()
+    tr = tfs.Program.wrap(
+        lambda y: {"s": y.sum(0, keepdims=True)}, fetches=["s"]
+    )
+    eager = tfs.map_blocks(
+        tr, tfs.map_blocks(m1, frame, engine=_EAGER), trim=True,
+        engine=_EAGER,
+    )
+    planned = tfs.map_blocks(
+        tr, tfs.map_blocks(m1, frame.lazy()), trim=True
+    ).frame()
+    assert planned.column_names == ["s"] == eager.column_names
+    np.testing.assert_array_equal(
+        np.asarray(eager.column("s").data), np.asarray(planned.column("s").data)
+    )
+    assert planned.block_sizes == eager.block_sizes
+
+
+def test_host_stage_step_runs_eager_inside_plan():
+    """A host-staged stage cannot fuse; the planner dispatches it
+    eagerly between fused groups, values unchanged."""
+    frame = _frame()
+    m1, m2 = _chain_programs()
+    hs = tfs.Program.wrap(lambda z: {"w": z + 1.0}, fetches=["w"])
+    stage = {"z": lambda cells: np.asarray(cells) * 2.0}
+    eager = tfs.map_blocks(
+        hs,
+        tfs.map_blocks(m2, tfs.map_blocks(m1, frame, engine=_EAGER),
+                       engine=_EAGER),
+        host_stage=stage,
+        engine=_EAGER,
+    )
+    planned = tfs.map_blocks(
+        hs,
+        tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy())),
+        host_stage=stage,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(eager.column("w").data),
+        np.asarray(planned.column("w").data),
+    )
+    rec = planned._last_records
+    assert any(r["dispatch"] == "eager" and r["reason"] == "host_stage"
+               for r in rec), rec
+    assert any(r["fused"] == 2 for r in rec), rec
+
+
+def test_param_update_flows_into_fused_rerun():
+    """``update_params`` on a stage program takes effect on the next
+    planned run (the composed program re-syncs live params) without
+    retracing."""
+    frame = _frame(n=64, nb=2)
+    w = np.float32(2.0)
+    m1 = tfs.Program.wrap(
+        lambda x, w: {"y": x * w}, fetches=["y"], params={"w": w}
+    )
+    m2 = tfs.Program.wrap(lambda y: {"z": y + 1.0}, fetches=["z"])
+
+    def planned_run():
+        return np.asarray(
+            tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy()))
+            .column("z")
+            .data
+        )
+
+    first = planned_run()
+    c0 = obs.counters()
+    m1.update_params(w=np.float32(5.0))
+    second = planned_run()
+    d = obs.counters_delta(c0)
+    assert d["program_traces"] == 0, d
+    eager = np.asarray(
+        tfs.map_blocks(m2, tfs.map_blocks(m1, frame, engine=_EAGER),
+                       engine=_EAGER).column("z").data
+    )
+    np.testing.assert_array_equal(second, eager)
+    assert not np.array_equal(first, second)
+
+
+def test_shared_subplan_executes_once():
+    """Two consumers of one intermediate: the subplan materialises once
+    (memoized), the second consumer adds only its own stage's traces."""
+    frame = _frame(n=64, nb=2, seed=7)
+    m1, m2 = _chain_programs()
+    m3 = tfs.Program.wrap(lambda y: {"q": y - 0.5}, fetches=["q"])
+    lz = frame.lazy()
+    a = tfs.map_blocks(m1, lz)
+    b = tfs.map_blocks(m2, a)
+    c = tfs.map_blocks(m3, a)
+    b_arr = np.asarray(b.column("z").data)  # materialises a, then b
+    assert a.is_materialized
+    c0 = obs.counters()
+    c_arr = np.asarray(c.column("q").data)  # must reuse a's memo
+    d = obs.counters_delta(c0)
+    # only m3's trace lands; a's stage (m1) does not re-execute
+    assert d["program_traces"] <= 1, d
+    np.testing.assert_array_equal(
+        c_arr,
+        np.asarray(
+            tfs.map_blocks(m3, tfs.map_blocks(m1, frame, engine=_EAGER),
+                           engine=_EAGER).column("q").data
+        ),
+    )
+    np.testing.assert_array_equal(
+        b_arr,
+        np.asarray(
+            tfs.map_blocks(m2, tfs.map_blocks(m1, frame, engine=_EAGER),
+                           engine=_EAGER).column("z").data
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# counter fences (serial)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_rerun_adds_no_traces_and_no_extra_h2d():
+    """The round-14 counter fence, serial leg: a re-built chain over the
+    same programs reuses the cached composed program — zero new traces —
+    and a fused dispatch stages no more H2D bytes than the eager chain
+    (the dead column is never staged by either)."""
+    frame = _frame(seed=11)
+    m1, m2 = _chain_programs()
+    c0 = obs.counters()
+    e = tfs.map_blocks(m2, tfs.map_blocks(m1, frame, engine=_EAGER),
+                       engine=_EAGER)
+    np.asarray(e.column("z").data)
+    d_eager = obs.counters_delta(c0)
+
+    c0 = obs.counters()
+    p = tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy()))
+    np.asarray(p.column("z").data)
+    d_first = obs.counters_delta(c0)
+    assert d_first["plan_fused_dispatches"] == 1, d_first
+    assert d_first["plan_columns_pruned"] == 2, d_first  # dead, k
+    assert d_first["h2d_bytes_staged"] <= d_eager["h2d_bytes_staged"]
+
+    c0 = obs.counters()
+    p2 = tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy()))
+    np.asarray(p2.column("z").data)
+    d_rerun = obs.counters_delta(c0)
+    assert d_rerun["program_traces"] == 0, d_rerun
+    assert d_rerun["backend_compiles"] == 0, d_rerun
+
+
+def test_unknown_column_error_at_materialisation():
+    frame = _frame()
+    bad = tfs.Program.wrap(lambda nope: {"w": nope + 1}, fetches=["w"])
+    lz = tfs.map_blocks(bad, frame.lazy())
+    with pytest.raises(ValidationError, match="nope"):
+        lz.collect()
+
+
+# ---------------------------------------------------------------------------
+# explain + routing
+# ---------------------------------------------------------------------------
+
+
+def test_explain_falls_back_to_schema_for_eager_frames():
+    frame = _frame()
+    assert tfs.explain(frame) == frame.schema.explain()
+
+
+def test_explain_renders_plan_without_executing():
+    frame = _frame()
+    m1, m2 = _chain_programs()
+    lz = tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy()))
+    text = tfs.explain(lz)
+    assert "logical plan" in text
+    assert "fused group 0" in text
+    assert "dead" in text and "pruned" in text
+    assert not lz.is_materialized  # explain must not execute
+    # after a run the per-group decision is appended
+    lz.collect()
+    text2 = tfs.explain(lz)
+    assert "last run:" in text2
+    assert "map_blocks+map_blocks" in text2
+
+
+def test_explain_marks_barriers_and_eager_stages():
+    frame = _frame()
+    m1, m2 = _chain_programs()
+    hs = tfs.Program.wrap(lambda z: {"w": z + 1.0}, fetches=["w"])
+    lz = frame.lazy()
+    a = tfs.map_blocks(m1, lz)
+    b = tfs.map_blocks(m2, a)
+    tfs.map_blocks(m2, a)  # second consumer -> barrier at a
+    c = tfs.map_blocks(
+        hs, b, host_stage={"z": lambda cells: np.asarray(cells)}
+    )
+    text = tfs.explain(c)
+    assert "barrier" in text
+    assert "eager (host_stage)" in text
+
+
+def test_tfs_plan_env_routes_plain_frames(monkeypatch):
+    monkeypatch.setenv("TFS_PLAN", "1")
+    frame = _frame(seed=13)
+    m1, m2 = _chain_programs()
+    out = tfs.map_blocks(m1, frame)
+    assert isinstance(out, tfs.LazyFrame)
+    chained = tfs.map_blocks(m2, out)
+    monkeypatch.setenv("TFS_PLAN", "0")
+    eager = tfs.map_blocks(m2, tfs.map_blocks(m1, frame))
+    np.testing.assert_array_equal(
+        np.asarray(eager.column("z").data),
+        np.asarray(chained.column("z").data),
+    )
+    # reduce over a PLAIN frame stays eager under the env knob (there is
+    # no plan to optimize) and returns the host dict directly
+    monkeypatch.setenv("TFS_PLAN", "1")
+    red = tfs.Program.wrap(
+        lambda x_input: {"x": x_input.sum(0)}, fetches=["x"]
+    )
+    got = tfs.reduce_blocks(red, frame)
+    assert isinstance(got, dict)
+    monkeypatch.setenv("TFS_PLAN", "0")
+
+
+def test_plan_default_off_returns_tensor_frames(monkeypatch):
+    monkeypatch.setenv("TFS_PLAN", "0")
+    frame = _frame()
+    m1, _ = _chain_programs()
+    out = tfs.map_blocks(m1, frame)
+    assert isinstance(out, tfs.TensorFrame)
+
+
+# ---------------------------------------------------------------------------
+# pooled legs (process-isolated: test_pooled_*)
+# ---------------------------------------------------------------------------
+
+
+def test_pooled_planner_six_verbs_bit_identical(monkeypatch):
+    """Planned == eager bytes with the device pool live, including the
+    chaos sub-leg — the fused dispatch rides the pooled block loop and
+    its retry/quarantine recovery unchanged."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    frame = _frame(n=160, nb=8)
+    m1, m2 = _chain_programs()
+    eager = _six_verbs(frame, m1, m2, engine=_EAGER)
+    planned = _six_verbs(frame.lazy(), m1, m2)
+    for name in eager:
+        np.testing.assert_array_equal(
+            eager[name], planned[name], err_msg=f"pooled {name}"
+        )
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", "6")
+    monkeypatch.setenv("TFS_BLOCK_BACKOFF_S", "0.001")
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:rate=0.3:seed=5")
+    chaotic = _six_verbs(_frame(n=160, nb=8).lazy(), m1, m2)
+    monkeypatch.setenv("TFS_FAULT_INJECT", "")
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", "0")
+    for name in eager:
+        np.testing.assert_array_equal(
+            eager[name], chaotic[name], err_msg=f"pooled chaos {name}"
+        )
+
+
+def test_pooled_planner_h2d_drop_and_decision(monkeypatch):
+    """The round-14 evidence fence, pooled leg: a planned chain with a
+    twice-consumed intermediate stages STRICTLY fewer H2D bytes than the
+    eager chain (fusion skips the intermediate re-stage; the dead column
+    is never staged at all), the auto-cache serves the second consumer
+    from shards, and the plan span records the per-group dispatch
+    decision."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    # pin the cost-model threshold so the cold fused group deterministically
+    # POOLS (host-assembled outputs -> the auto-cache story under test);
+    # the serial decision has its own test below
+    monkeypatch.setenv("TFS_PLAN_POOL_MIN_INTENSITY", "0")
+    n, nb, d = 256, 8, 8
+    rng = np.random.RandomState(0)
+    data = {
+        "x": rng.rand(n, d).astype(np.float32),
+        "dead": rng.rand(n, d).astype(np.float32),
+    }
+    col_bytes = data["x"].nbytes
+    m1, m2 = _chain_programs()
+    red = tfs.Program.wrap(
+        lambda z_input: {"z": (z_input * 1.3).sum(0)}, fetches=["z"]
+    )
+
+    def run(frame_or_lazy, engine=None):
+        a = tfs.map_blocks(m1, frame_or_lazy, engine=engine)
+        b = tfs.map_blocks(m2, a, engine=engine)
+        r1 = tfs.reduce_blocks(red, b, engine=engine)
+        r2 = tfs.reduce_blocks(red, b, engine=engine)
+        return r1, r2
+
+    eager_frame = tfs.TensorFrame.from_arrays(data, num_blocks=nb)
+    c0 = obs.counters()
+    e1, e2 = run(eager_frame, engine=_EAGER)
+    d_eager = obs.counters_delta(c0)
+
+    obs.enable()
+    try:
+        planned_frame = tfs.TensorFrame.from_arrays(data, num_blocks=nb)
+        c0 = obs.counters()
+        p1, p2 = run(planned_frame.lazy())
+        d_planned = obs.counters_delta(c0)
+        spans = obs.last_spans(10)
+    finally:
+        obs.disable()
+
+    np.testing.assert_array_equal(e1["z"], p1["z"])
+    np.testing.assert_array_equal(e2["z"], p2["z"])
+    # strictly fewer staged bytes: the fused chain never re-stages the
+    # intermediate, and the second reduce reads the auto-cache's shards
+    assert (
+        d_planned["h2d_bytes_staged"] < d_eager["h2d_bytes_staged"]
+    ), (d_planned, d_eager)
+    # the dead column's bytes never moved: everything staged is accounted
+    # for by x (fused entry) + z (first reduce) + z (auto-cache build)
+    assert d_planned["h2d_bytes_staged"] <= 3 * col_bytes, d_planned
+    assert d_planned["plan_fused_dispatches"] == 1, d_planned
+    assert d_planned["plan_cache_inserts"] == 1, d_planned
+    assert d_planned["cache_shard_hits"] >= 1, d_planned
+    plan_spans = [s for s in spans if s["verb"] == "plan"]
+    assert plan_spans, [s["verb"] for s in spans]
+    stages = plan_spans[0]["planner"]["stages"]
+    fused = [r for r in stages if r["fused"] >= 2]
+    assert fused and fused[0]["dispatch"] in ("pool", "serial"), stages
+    assert "reason" in fused[0]
+    assert "dead" in fused[0]["pruned"], stages
+
+
+def test_pooled_planner_steady_state_rerun_zero_traces(monkeypatch):
+    """After the first planned epoch (compiles) and the second (the
+    auto-cache promotion flips the chain to affinity executables once),
+    every later epoch re-runs with ZERO new program traces."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_PLAN_POOL_MIN_INTENSITY", "0")
+    frame = _frame(n=256, nb=8)
+    m1, m2 = _chain_programs()
+    red = tfs.Program.wrap(
+        lambda z_input: {"z": (z_input * 1.3).sum(0)}, fetches=["z"]
+    )
+
+    def epoch():
+        b = tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy()))
+        return tfs.reduce_blocks(red, b)
+
+    first = epoch()
+    second = epoch()
+    c0 = obs.counters()
+    third = epoch()
+    d = obs.counters_delta(c0)
+    assert d["program_traces"] == 0, d
+    np.testing.assert_array_equal(first["z"], second["z"])
+    np.testing.assert_array_equal(first["z"], third["z"])
+
+
+def test_pooled_planner_autocache_weakref_refunds_budget(monkeypatch):
+    """The auto-inserted cache registers a ``weakref.finalize`` uncache:
+    when every reference to the planned intermediate is dropped, the
+    shards release and ``TFS_HBM_BUDGET`` accounting returns to its
+    prior level — no silent budget leak for planner-created caches."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_PLAN_POOL_MIN_INTENSITY", "0")
+    monkeypatch.setenv("TFS_HBM_BUDGET", "64M")
+    base = frame_cache.budget_bytes_resident()
+    frame = _frame(n=256, nb=8)
+    m1, m2 = _chain_programs()
+    red = tfs.Program.wrap(
+        lambda z_input: {"z": (z_input * 1.3).sum(0)}, fetches=["z"]
+    )
+    lz = frame.lazy()
+    b = tfs.map_blocks(m2, tfs.map_blocks(m1, lz))
+    c0 = obs.counters()
+    r1 = tfs.reduce_blocks(red, b)
+    r2 = tfs.reduce_blocks(red, b)
+    d = obs.counters_delta(c0)
+    assert d["plan_cache_inserts"] >= 1, d
+    assert frame_cache.budget_bytes_resident() > base
+    np.testing.assert_array_equal(r1["z"], r2["z"])
+    del lz, b, frame
+    gc.collect()
+    assert frame_cache.budget_bytes_resident() == base
+
+
+def test_pooled_planner_sharded_cached_entry_affinity(monkeypatch):
+    """A planned chain over a user-sharded-cached frame dispatches on
+    the affinity path (decision 'affinity') and matches eager bytes."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    frame = _frame(n=160, nb=8)
+    m1, m2 = _chain_programs()
+    eager = tfs.map_blocks(m2, tfs.map_blocks(m1, frame, engine=_EAGER),
+                           engine=_EAGER)
+    cached = frame.cache(sharded=True)
+    assert frame_cache.active_cache(cached) is not None
+    lz = tfs.map_blocks(m2, tfs.map_blocks(m1, cached.lazy()))
+    np.testing.assert_array_equal(
+        np.asarray(eager.column("z").data),
+        np.asarray(lz.column("z").data),
+    )
+    rec = [r for r in lz._last_records if r["fused"] >= 2]
+    assert rec and rec[0]["dispatch"] == "affinity", lz._last_records
+
+
+def test_pooled_planner_cold_low_intensity_stays_serial(monkeypatch):
+    """Decision layer: a COLD, transfer-bound fused chain (elementwise
+    ops, default threshold) keeps the serial fused dispatch — the
+    recorded reason names the cost model — and its device-resident
+    chaining means the planned leg stages ONLY the consumed entry
+    column.  A re-run (warm executables) flips the decision to pool."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.delenv("TFS_PLAN_POOL_MIN_INTENSITY", raising=False)
+    frame = _frame(n=256, nb=8, d=8)
+    # pure elementwise adds/muls: unambiguously below the default
+    # 1 flop/byte threshold whatever the cost model charges for them.
+    # The planned leg runs FIRST: the eager verbs share the same
+    # Program jit caches, so running them first would make the chain
+    # "warm" and legitimately flip the decision to pool.
+    m1 = tfs.Program.wrap(lambda x: {"y": x + 1.0}, fetches=["y"])
+    m2 = tfs.Program.wrap(lambda y: {"z": y * 2.0}, fetches=["z"])
+    c0 = obs.counters()
+    lz = tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy()))
+    planned_z = np.asarray(lz.column("z").data)
+    d1 = obs.counters_delta(c0)
+    eager = tfs.map_blocks(m2, tfs.map_blocks(m1, frame, engine=_EAGER),
+                           engine=_EAGER)
+    np.testing.assert_array_equal(
+        np.asarray(eager.column("z").data), planned_z
+    )
+    rec = [r for r in lz._last_records if r["fused"] >= 2]
+    assert rec and rec[0]["dispatch"] == "serial", lz._last_records
+    assert rec[0]["reason"] == "transfer_bound_cold", rec
+    assert rec[0]["intensity_flops_per_byte"] is not None, rec
+    # serial fused: only the consumed entry column staged, once
+    assert d1["h2d_bytes_staged"] <= frame.column("x").data.nbytes, d1
+    # warm re-run: the same chain now pools (executables already traced)
+    lz2 = tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy()))
+    np.testing.assert_array_equal(
+        np.asarray(lz2.column("z").data), planned_z
+    )
+    rec2 = [r for r in lz2._last_records if r["fused"] >= 2]
+    assert rec2 and rec2[0]["reason"] in (
+        "warm_executables",
+        "sharded_cache_resident",
+    ), lz2._last_records

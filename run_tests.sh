@@ -86,6 +86,20 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 JAX_PLATFORMS=cpu \
   python -m pytest tests/test_trace_metrics.py -q
 
+# Attribution tier: the request-scoped telemetry tests re-run with the
+# round-15 knobs LIVE — TFS_SLOW_REQUEST_MS low enough that real verb
+# requests emit the structured slow-request log, TFS_TRACE=1 so
+# correlation ids land on real trace events, and the forced 8-device
+# host so per-device ledger attribution exercises the pool scheduler.
+# The main suite runs the same file with conftest pinning the knobs off
+# (tests drive thresholds via monkeypatch); this tier proves the env
+# wiring end to end, ledger + explain(analyze=True) included.
+echo "== attribution tier (request telemetry, ledger + analyze live) =="
+TFS_SLOW_REQUEST_MS=1 TFS_TRACE=1 \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_request_telemetry.py -q
+
 # Planner tier: the lazy verb-graph planner's tests re-run with
 # TFS_PLAN=1 LIVE (the main suite pins it off via conftest and the
 # tests opt in per frame via frame.lazy(); this tier proves the env

@@ -20,6 +20,7 @@ The user-facing module mirrors the reference's python API
 
 from . import compile_cache, dsl, faults, observability, resilience
 from .analyze import analyze, explain, print_schema
+from .doctor import doctor
 from .builder import OpBuilder
 from .observability import initialize_logging
 from .data import FrameLoader
@@ -81,6 +82,7 @@ __all__ = [
     "resilience",
     "faults",
     "analyze",
+    "doctor",
     "explain",
     "print_schema",
     "ScalarType",

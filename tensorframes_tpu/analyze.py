@@ -73,7 +73,7 @@ def print_schema(frame: TensorFrame) -> None:
     print(explain(frame))
 
 
-def explain(frame: TensorFrame) -> str:
+def explain(frame: TensorFrame, analyze: bool = False) -> str:
     """Pretty-printed tensor schema (reference ``explain``,
     ``DebugRowOps.scala:528-545`` / ``DataFrameInfo.scala:10-17``).
 
@@ -81,7 +81,22 @@ def explain(frame: TensorFrame) -> str:
     this renders the optimized logical plan instead — stage list, fused
     groups, pruned columns, cache insertions, and the last run's
     per-group pool/serial decisions — without executing anything.
-    Eager frames keep the round-1 schema rendering."""
+    Eager frames keep the round-1 schema rendering.
+
+    ``analyze=True`` (round 15, the reference's ``EXPLAIN ANALYZE``
+    surface): EXECUTE the plan under a request ledger and append the
+    measured report — per-group wall time, bytes staged, pool occupancy,
+    and each pool-vs-serial decision with its observed payoff.  Only
+    planned frames can be analyzed (an eager frame has no pending plan
+    to execute; call ``frame.lazy()`` and chain verbs first)."""
     if getattr(frame, "_tfs_lazy", False):
+        if analyze:
+            return frame.explain_analyze()
         return frame.explain_plan()
+    if analyze:
+        raise ValueError(
+            "explain(analyze=True) needs a planned frame — call "
+            "frame.lazy() (or set TFS_PLAN=1) and chain verbs before "
+            "analyzing; an eager frame has no pending plan to execute"
+        )
     return frame.schema.explain()

@@ -84,7 +84,8 @@ def _read_grace_s(remaining_s: float) -> float:
 # server dedups: a retry never races a still-running original into a
 # duplicate admission slot (it waits for the original's outcome).
 _SAFE_METHODS = frozenset(
-    {"ping", "schema", "health", "hello", "release", "metrics"}
+    {"ping", "schema", "health", "hello", "release", "metrics",
+     "attribution"}
 )
 
 
@@ -176,9 +177,20 @@ class BridgeClient:
         backoff_s: float = DEFAULT_BACKOFF_S,
         jitter: float = 1.0,
         rng=None,
+        tenant: Optional[str] = None,
     ):
         self._host = host
         self._port = int(port)
+        # request-scoped telemetry (round 15): every GATED call is
+        # stamped with a fresh correlation id (STABLE across that
+        # call's reconnect retries, so a retried request attributes to
+        # one request server-side; safe/ungated methods are never
+        # attributed and carry none); ``tenant`` rides the envelope too
+        # and labels the server's bounded-cardinality tfs_request_*
+        # metrics.  ``last_correlation_id`` is the most recent GATED
+        # call's cid — the handle ``attribution()`` looks up.
+        self._tenant = tenant
+        self.last_correlation_id: Optional[str] = None
         self._timeout_s = (
             timeout_s
             if timeout_s is not None
@@ -330,7 +342,17 @@ class BridgeClient:
         )
         safe = method in _SAFE_METHODS
         detector: Optional[resilience.FailureDetector] = None
+        # one correlation id per LOGICAL gated call: reconnect retries
+        # re-send the same cid (like the idem token), so server-side
+        # attribution and trace events string the whole call together.
+        # Safe methods are ungated server-side — never attributed — so
+        # minting/recording a cid for them would clobber
+        # ``last_correlation_id`` with an id the ``attribution`` RPC
+        # can never find (e.g. the attribution lookup itself)
+        cid = None if safe else observability.new_correlation_id()
         with self._lock:
+            if cid is not None:
+                self.last_correlation_id = cid
             self._next_id += 1
             mid = self._next_id
             idem = None if safe else f"{self._client_id}:{mid}"
@@ -374,6 +396,10 @@ class BridgeClient:
                         "method": method,
                         "params": encode_value(params, bins),
                     }
+                    if cid is not None:
+                        msg["cid"] = cid
+                        if self._tenant is not None:
+                            msg["tenant"] = self._tenant
                     if deadline_end is not None:
                         # re-computed AFTER any reconnect work: the
                         # server must be granted only what truly remains
@@ -523,6 +549,16 @@ class BridgeClient:
         p50/p95/p99 — the scrape surface for deployments without the
         ``TFS_METRICS_PORT`` HTTP endpoint (ungated, like ``health``)."""
         return self.call("metrics")["text"]
+
+    def attribution(
+        self, correlation_id: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Per-request cost attribution (round 15, ungated).  With a
+        ``correlation_id`` (e.g. :attr:`last_correlation_id` after a
+        verb call) returns that request's ledger snapshot — counters
+        delta, blocks/rows per device, per-verb latency, wall time;
+        without one returns the server's recent ledgers, newest last."""
+        return self.call("attribution", correlation_id=correlation_id)
 
     def create_frame(
         self,

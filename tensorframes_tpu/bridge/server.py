@@ -140,8 +140,15 @@ _IDEM_WAIT_CAP_S = 600.0
 _ALL_METHODS = (
     _GATED_METHODS
     | _UNGATED_METHODS
-    | frozenset({"hello", "health", "metrics", "end_session"})
+    | frozenset({"hello", "health", "metrics", "attribution",
+                 "end_session"})
 )
+
+# ledger snapshots retained for the ``attribution`` RPC, per server:
+# bounded (LRU by arrival) so a long-lived server's attribution window
+# is a sliding recent-history, not unbounded growth
+_ATTRIBUTION_CAP = 256
+_ATTRIBUTION_RECENT = 32  # returned by a no-cid attribution query
 
 
 class BridgeServerError(RuntimeError):
@@ -605,6 +612,7 @@ class _Handler(socketserver.StreamRequestHandler):
             pass
         self._session: Optional[_Session] = None
         self._err_logged = False
+        self._req_cid: Optional[str] = None
 
     def finish(self):
         if self._session is not None:
@@ -687,13 +695,23 @@ class _Handler(socketserver.StreamRequestHandler):
         )
         t0 = time.perf_counter()
         t_tr = t0 if observability.trace_enabled() else None
+        self._req_cid = None  # set by _dispatch for gated requests
         try:
             return self._dispatch(msg, rbins, method, track)
         finally:
             observability.record_latency(
                 "bridge", label, time.perf_counter() - t0
             )
-            observability.trace_complete(f"request {label}", track, t_tr)
+            # the request event closes AFTER the ledger context is
+            # reset, so the cid is passed explicitly (round 15)
+            if self._req_cid is not None:
+                observability.trace_complete(
+                    f"request {label}", track, t_tr, cid=self._req_cid
+                )
+            else:
+                observability.trace_complete(
+                    f"request {label}", track, t_tr
+                )
 
     def _dispatch(self, msg: dict, rbins: list, method, track: str):
         """-> ``(reply_without_id, bins)``; raises ``_DropReply`` for an
@@ -725,6 +743,20 @@ class _Handler(socketserver.StreamRequestHandler):
             # ungated like health: a saturated or draining server must
             # still be scrapeable — that is when the metrics matter
             return {"result": {"text": server.metrics_text()}}, []
+        if method == "attribution":
+            # ungated like metrics: per-request cost attribution must be
+            # readable from a saturated server (that is when a tenant's
+            # spend matters most)
+            params = decode_value(msg.get("params") or {}, rbins)
+            bins = []
+            return {
+                "result": encode_value(
+                    server.attribution_snapshot(
+                        params.get("correlation_id")
+                    ),
+                    bins,
+                )
+            }, bins
 
         sess = self._session
         if sess is None:
@@ -770,6 +802,40 @@ class _Handler(socketserver.StreamRequestHandler):
             ),
             label=f"bridge:{method}",
         )
+
+        # request-scoped telemetry (round 15): the client-stamped
+        # correlation id (or a server-minted one) becomes a RequestLedger
+        # on the contextvar — alongside the cancel scope — for the whole
+        # gated request: admission wait, execution, every engine /
+        # staging-lane / fault counter bump and trace event attribute to
+        # it.  The envelope keys are additive (old clients simply get
+        # server-minted cids).
+        cid = msg.get("cid")
+        cid = cid if isinstance(cid, str) and cid else (
+            observability.new_correlation_id()
+        )
+        tenant = msg.get("tenant")
+        tenant = tenant if isinstance(tenant, str) and tenant else None
+        self._req_cid = cid
+        ledger = observability.RequestLedger(
+            cid, tenant=tenant, method=f"bridge:{method}"
+        )
+        ledger_token = observability.activate_request(ledger)
+        try:
+            return self._dispatch_gated(
+                msg, rbins, method, track, sess, scope, fplan
+            )
+        finally:
+            observability.deactivate_request(ledger_token)
+            ledger.finish()
+            server._record_attribution(ledger)
+
+    def _dispatch_gated(
+        self, msg, rbins, method, track, sess, scope, fplan
+    ):
+        """The admission-gated request body (factored out in round 15 so
+        the request-ledger install/finish wraps it cleanly)."""
+        server = self.server  # type: ignore[attr-defined]
 
         # idempotency dedup BEFORE admission: a retried request whose
         # first run already recorded an outcome is served that outcome
@@ -969,6 +1035,12 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         )
         self._sessions: Dict[str, _Session] = {}
         self._sessions_lock = threading.Lock()
+        # per-request attribution history (round 15): ledger snapshots
+        # keyed by correlation id, bounded LRU-by-arrival
+        self._attribution: "collections.OrderedDict[str, dict]" = (
+            collections.OrderedDict()
+        )
+        self._attribution_lock = threading.Lock()
         self._scopes: set = set()
         self._scopes_lock = threading.Lock()
         self._closed = False
@@ -1143,6 +1215,51 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         return observability.metrics_text(
             extra_gauges=self._admission_gauges()
         )
+
+    # -- per-request attribution (round 15) ----------------------------------
+
+    def _record_attribution(self, ledger) -> None:
+        """Retain one finished request ledger's snapshot for the
+        ``attribution`` RPC (bounded history).  A retry served from the
+        idempotency dedup cache arrives under the SAME correlation id
+        as its original execution (the client keeps the cid stable
+        across reconnects, like the idem token) with a near-empty
+        ledger — it must never REPLACE the original's attribution, so a
+        non-executing snapshot yields to an existing executed one."""
+        snap = ledger.snapshot()
+        cid = ledger.correlation_id
+        with self._attribution_lock:
+            old = self._attribution.get(cid)
+            if (
+                old is not None
+                and old["counters"].get("bridge_verbs_executed")
+                and not snap["counters"].get("bridge_verbs_executed")
+            ):
+                self._attribution.move_to_end(cid)
+                return
+            self._attribution[cid] = snap
+            self._attribution.move_to_end(cid)
+            while len(self._attribution) > _ATTRIBUTION_CAP:
+                self._attribution.popitem(last=False)
+
+    def attribution_snapshot(
+        self, correlation_id: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """The ``attribution`` RPC body: one request's ledger (by
+        correlation id) or the recent-request history, newest last —
+        counters-delta resource usage, blocks/rows per device, per-verb
+        latency, and wall time, each stamped with its correlation id and
+        tenant."""
+        with self._attribution_lock:
+            if correlation_id is not None:
+                snap = self._attribution.get(correlation_id)
+                return {
+                    "found": snap is not None,
+                    "ledger": snap,
+                    "retained": len(self._attribution),
+                }
+            recent = list(self._attribution.values())[-_ATTRIBUTION_RECENT:]
+            return {"recent": recent, "retained": len(self._attribution)}
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -40,9 +40,11 @@ Usage (the bridge handler is the canonical caller)::
   budget or back off; it must surface *now*.
 
 The scope rides a ``contextvars.ContextVar``, so concurrent bridge
-handler threads each see only their own request's scope, and engine
-worker threads (prefetch lanes) — which do not inherit the context —
-never observe it: staging is cheap host work, and cancelling it
+handler threads each see only their own request's scope.  Engine worker
+threads (prefetch lanes) inherit a COPY of the context since round 15 —
+for request-ledger attribution (``observability.request_ledger``) — but
+staging code never calls :func:`checkpoint`, so the copied scope stays
+inert there: staging is cheap host work, and cancelling it
 mid-``device_put`` would buy nothing but torn staging state.
 """
 

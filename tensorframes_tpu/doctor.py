@@ -1,0 +1,353 @@
+"""``tfs.doctor()`` — the performance advisor (round 15).
+
+The observability stack accumulates the evidence (counters, always-on
+latency histograms, request ledgers, span annotations); this module
+reads it and emits **structured diagnoses** for the anti-patterns the
+earlier rounds taught us to recognise — each one naming the knob that
+fixes it, so an operator staring at a slow deployment gets "turn this"
+instead of a wall of metrics.
+
+Rules (each fires at most one diagnostic):
+
+* **retrace_storm** — a verb keeps re-tracing its program (traces grow
+  with invocations instead of flattening after warmup).  Almost always
+  uneven block sizes defeating the jit signature cache; the fix is
+  shape-canonical bucketing (``TFS_BLOCK_BUCKETS``) and/or priming via
+  ``warmup()`` + ``TFS_COMPILE_CACHE``.
+* **bucket_miss_churn** — XLA backend compiles keep happening but the
+  persistent compilation cache misses dominate: compiles are paid from
+  scratch every process.  Configure ``TFS_COMPILE_CACHE``.
+* **cache_thrash** — the HBM frame-cache LRU evicts about as often as
+  it serves shards: the working set does not fit the budget and the
+  cache is churning instead of accelerating.  Raise ``TFS_HBM_BUDGET``
+  or cache fewer columns.
+* **low_pool_occupancy** — pooled dispatches leave devices idle (mean
+  occupancy under 50%, or one device does most of the blocks).  Raise
+  ``TFS_PREFETCH_BLOCKS`` (staging is starving the pool) or repartition
+  to more blocks per device.
+* **shed_burn** — admission control sheds a significant fraction of
+  offered requests: the server is undersized for the load.  Raise
+  ``TFS_BRIDGE_MAX_INFLIGHT`` / ``TFS_BRIDGE_QUEUE_DEPTH`` or add
+  servers.
+* **retry_burn** — transient block failures are being absorbed in
+  volume; throughput survives but latency pays the backoff.  Check chip
+  health (``health`` RPC quarantine history) and
+  ``TFS_QUARANTINE_AFTER``.
+* **slow_tail** — a verb/method's p99 is far above its p50 (default
+  ratio 32x): a minority of requests pay a disproportionate price —
+  usually retrace storms, retries, or admission queueing surfaced
+  upstream; pair with the matching diagnostic and per-request
+  attribution (``attribution`` RPC) to find the victims.
+
+Every input is injectable (``counters=``, ``latency=``, ``ledger=``,
+``spans=``) so tests and offline analysis run the same rules over
+recorded snapshots; with no arguments the live process state is read.
+``doctor()`` returns the diagnostics as a list of dicts —
+``{code, severity, summary, evidence, knob, advice}`` — and
+``render()`` formats them for humans.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+from . import observability
+
+__all__ = ["doctor", "render"]
+
+# thresholds: deliberately conservative — a diagnostic that fires on a
+# healthy process erodes trust faster than a missed one
+MIN_EVENTS = 8  # evidence floor before any rule may fire
+RETRACE_RATIO = 0.5  # traces per invocation past warmup
+OCCUPANCY_FLOOR = 0.5  # mean pooled occupancy below this is "idle"
+SHED_RATE = 0.10
+TAIL_RATIO = 32.0  # p99 / p50
+
+
+def _diag(
+    code: str,
+    severity: str,
+    summary: str,
+    evidence: Mapping[str, Any],
+    knob: str,
+    advice: str,
+) -> Dict[str, Any]:
+    return {
+        "code": code,
+        "severity": severity,
+        "summary": summary,
+        "evidence": dict(evidence),
+        "knob": knob,
+        "advice": advice,
+    }
+
+
+def _rule_retrace_storm(c, latency) -> Optional[Dict[str, Any]]:
+    by_verb = c.get("by_verb") or {}
+    worst = None
+    for verb, vc in by_verb.items():
+        traces = vc.get("program_traces", 0)
+        calls = (latency.get(f"verb:{verb}") or {}).get("count", 0)
+        if calls < MIN_EVENTS or traces < MIN_EVENTS:
+            continue
+        ratio = traces / calls
+        if ratio >= RETRACE_RATIO and (
+            worst is None or ratio > worst[1]
+        ):
+            worst = (verb, ratio, traces, calls)
+    if worst is None:
+        return None
+    verb, ratio, traces, calls = worst
+    return _diag(
+        "retrace_storm",
+        "warn",
+        f"{verb} re-traced its program {traces} times over {calls} "
+        f"calls ({ratio:.2f} traces/call) — steady state should be ~0",
+        {"verb": verb, "traces": traces, "calls": calls,
+         "traces_per_call": round(ratio, 3)},
+        "TFS_BLOCK_BUCKETS",
+        "uneven block sizes mint one executable per distinct shape; "
+        "enable shape-canonical bucketing (TFS_BLOCK_BUCKETS) so O(log "
+        "max-dim) buckets serve every size, and prime with warmup() + "
+        "TFS_COMPILE_CACHE so fresh processes skip XLA entirely",
+    )
+
+
+def _rule_bucket_miss_churn(c) -> Optional[Dict[str, Any]]:
+    compiles = c.get("backend_compiles", 0)
+    hits = c.get("persistent_cache_hits", 0)
+    misses = c.get("persistent_cache_misses", 0)
+    if compiles < MIN_EVENTS:
+        return None
+    if hits + misses == 0:
+        return _diag(
+            "bucket_miss_churn",
+            "info",
+            f"{compiles} XLA backend compiles with NO persistent "
+            f"compilation cache configured — every process pays them "
+            f"from scratch",
+            {"backend_compiles": compiles, "persistent_cache_hits": 0,
+             "persistent_cache_misses": 0},
+            "TFS_COMPILE_CACHE",
+            "set TFS_COMPILE_CACHE to a shared directory so compiled "
+            "executables persist across processes (warmup() then turns "
+            "cold starts into disk fetches)",
+        )
+    if misses > max(hits, MIN_EVENTS - 1):
+        return _diag(
+            "bucket_miss_churn",
+            "warn",
+            f"persistent compile cache misses ({misses}) exceed hits "
+            f"({hits}) over {compiles} compiles — the cache is not "
+            f"absorbing the compile load",
+            {"backend_compiles": compiles, "persistent_cache_hits": hits,
+             "persistent_cache_misses": misses},
+            "TFS_COMPILE_CACHE",
+            "the executed shapes are not converging: check that "
+            "TFS_BLOCK_BUCKETS is on so block sizes canonicalize, and "
+            "that the TFS_COMPILE_CACHE directory is shared and "
+            "writable across processes",
+        )
+    return None
+
+
+def _rule_cache_thrash(c) -> Optional[Dict[str, Any]]:
+    ev = c.get("cache_evictions", 0)
+    hits = c.get("cache_shard_hits", 0)
+    if ev < max(4, MIN_EVENTS // 2):
+        return None
+    if ev < hits / 4:
+        return None  # evicting a little while serving a lot is healthy
+    return _diag(
+        "cache_thrash",
+        "warn",
+        f"the HBM frame cache evicted {ev} shard(s) against {hits} "
+        f"shard hit(s) — the working set is cycling through the budget "
+        f"instead of residing in it",
+        {"cache_evictions": ev, "cache_shard_hits": hits},
+        "TFS_HBM_BUDGET",
+        "raise TFS_HBM_BUDGET so the live frames' shards fit, or "
+        "cache() fewer columns/frames (each eviction re-pays the H2D "
+        "it was supposed to save; with TFS_SPILL_DIR set, disk I/O too)",
+    )
+
+
+def _rule_low_pool_occupancy(c, ledger, spans) -> Optional[Dict[str, Any]]:
+    if c.get("pool_blocks", 0) < MIN_EVENTS:
+        return None
+    # prefer span evidence (measured occupancy); fall back to the
+    # ledger's blocks-per-device imbalance
+    occs: List[float] = []
+    devices = 0
+    for rec in spans or ():
+        dp = rec.get("device_pool")
+        if not dp or not dp.get("occupancy"):
+            continue
+        occ = dp["occupancy"]
+        if len(occ) >= 2:
+            occs = occ
+            devices = dp.get("devices", len(occ))
+    if occs:
+        mean = sum(occs) / len(occs)
+        if mean >= OCCUPANCY_FLOOR:
+            return None
+        return _diag(
+            "low_pool_occupancy",
+            "warn",
+            f"pooled dispatch left devices idle: mean occupancy "
+            f"{mean:.2f} across {devices} device(s) "
+            f"(per-device {occs})",
+            {"occupancy": occs, "mean_occupancy": round(mean, 3),
+             "devices": devices},
+            "TFS_PREFETCH_BLOCKS",
+            "the pool is starving: raise TFS_PREFETCH_BLOCKS so staging "
+            "lanes run further ahead of compute, or repartition the "
+            "frame into more blocks so every device has work in flight",
+        )
+    bpd = (ledger or {}).get("blocks_per_device") or {}
+    if len(bpd) >= 2:
+        counts = sorted(int(v) for v in bpd.values())
+        if counts[-1] >= 4 * max(1, counts[0]) and sum(counts) >= MIN_EVENTS:
+            return _diag(
+                "low_pool_occupancy",
+                "info",
+                f"block placement is skewed: blocks per device {bpd} — "
+                f"the busiest device carries {counts[-1]}x the quietest's "
+                f"{counts[0]}",
+                {"blocks_per_device": dict(bpd)},
+                "TFS_PREFETCH_BLOCKS",
+                "skewed block sizes serialize on one device; repartition "
+                "into more, evener blocks (the least-loaded scheduler "
+                "balances rows, but cannot split a giant block)",
+            )
+    return None
+
+
+def _rule_shed_burn(c) -> Optional[Dict[str, Any]]:
+    shed = c.get("bridge_shed", 0)
+    executed = c.get("bridge_verbs_executed", 0)
+    offered = shed + executed
+    if shed < MIN_EVENTS or offered == 0:
+        return None
+    rate = shed / offered
+    if rate < SHED_RATE:
+        return None
+    return _diag(
+        "shed_burn",
+        "critical" if rate >= 0.5 else "warn",
+        f"admission control shed {shed} of {offered} offered requests "
+        f"({rate:.0%}) — clients are burning retries against a full "
+        f"server",
+        {"bridge_shed": shed, "bridge_verbs_executed": executed,
+         "shed_rate": round(rate, 3)},
+        "TFS_BRIDGE_MAX_INFLIGHT",
+        "raise TFS_BRIDGE_MAX_INFLIGHT / TFS_BRIDGE_QUEUE_DEPTH if the "
+        "host has headroom (watch occupancy first), or add servers and "
+        "route on the health RPC — sheds are the backpressure working, "
+        "but a sustained rate means the fleet is undersized",
+    )
+
+
+def _rule_retry_burn(c) -> Optional[Dict[str, Any]]:
+    retries = c.get("block_retries", 0)
+    if retries < MIN_EVENTS:
+        return None
+    quarantined = c.get("devices_quarantined", 0)
+    return _diag(
+        "retry_burn",
+        "warn",
+        f"{retries} block retries absorbed"
+        + (f", {quarantined} device quarantine(s)" if quarantined else "")
+        + " — results are intact but every retry pays re-staging plus "
+          "backoff",
+        {"block_retries": retries, "devices_quarantined": quarantined,
+         "faults_injected": c.get("faults_injected", 0)},
+        "TFS_QUARANTINE_AFTER",
+        "check the health RPC's quarantined_devices history for a sick "
+        "chip; lower TFS_QUARANTINE_AFTER to drain it sooner, and "
+        "consider TFS_BLOCK_BACKOFF_S if retry latency dominates p99",
+    )
+
+
+def _rule_slow_tail(latency) -> Optional[Dict[str, Any]]:
+    worst = None
+    for key, s in latency.items():
+        if s.get("count", 0) < MIN_EVENTS * 2:
+            continue
+        p50, p99 = s.get("p50_s", 0.0), s.get("p99_s", 0.0)
+        if p50 <= 0:
+            continue
+        ratio = p99 / p50
+        if ratio >= TAIL_RATIO and (worst is None or ratio > worst[1]):
+            worst = (key, ratio, p50, p99, s["count"])
+    if worst is None:
+        return None
+    key, ratio, p50, p99, count = worst
+    return _diag(
+        "slow_tail",
+        "info",
+        f"{key} p99 ({p99:.4f}s) is {ratio:.0f}x its p50 ({p50:.6f}s) "
+        f"over {count} observations — a minority of requests pay a "
+        f"disproportionate price",
+        {"series": key, "p50_s": p50, "p99_s": p99,
+         "tail_ratio": round(ratio, 1), "count": count},
+        "TFS_SLOW_REQUEST_MS",
+        "set TFS_SLOW_REQUEST_MS to log the slow requests' ledgers "
+        "(correlation id + counters delta), then read the attribution "
+        "RPC for the victims — tails here usually trace to a retrace "
+        "storm, retry burn, or admission queueing diagnosed above",
+    )
+
+
+def doctor(
+    counters: Optional[Mapping[str, Any]] = None,
+    latency: Optional[Mapping[str, Mapping[str, Any]]] = None,
+    ledger: Optional[Mapping[str, Any]] = None,
+    spans: Optional[Sequence[Mapping[str, Any]]] = None,
+) -> List[Dict[str, Any]]:
+    """Diagnose the process's (or the given snapshots') performance
+    state.  Returns structured diagnostics, worst first — each names
+    the anti-pattern, the evidence, and the knob to turn.  An empty
+    list means nothing fired (which is the healthy answer, not a
+    missing feature).
+
+    ``counters``/``latency`` default to the live
+    :func:`observability.counters` / :func:`observability.latency_snapshot`;
+    ``ledger`` takes a :meth:`RequestLedger.snapshot` (or an
+    ``attribution`` RPC body) to scope the pool-skew rule to one
+    request; ``spans`` takes :func:`observability.last_spans` records
+    for measured pool occupancy."""
+    c = dict(counters if counters is not None else observability.counters())
+    lat = dict(
+        latency if latency is not None else observability.latency_snapshot()
+    )
+    if spans is None:
+        spans = observability.last_spans(64)
+    out: List[Dict[str, Any]] = []
+    for rule in (
+        lambda: _rule_shed_burn(c),
+        lambda: _rule_retrace_storm(c, lat),
+        lambda: _rule_bucket_miss_churn(c),
+        lambda: _rule_cache_thrash(c),
+        lambda: _rule_low_pool_occupancy(c, ledger, spans),
+        lambda: _rule_retry_burn(c),
+        lambda: _rule_slow_tail(lat),
+    ):
+        d = rule()
+        if d is not None:
+            out.append(d)
+    sev_rank = {"critical": 0, "warn": 1, "info": 2}
+    out.sort(key=lambda d: sev_rank.get(d["severity"], 3))
+    return out
+
+
+def render(diagnostics: Sequence[Mapping[str, Any]]) -> str:
+    """Human rendering of :func:`doctor`'s output."""
+    if not diagnostics:
+        return "doctor: no anti-patterns detected"
+    lines = [f"doctor: {len(diagnostics)} diagnostic(s)"]
+    for d in diagnostics:
+        lines.append(f" [{d['severity']}] {d['code']}: {d['summary']}")
+        lines.append(f"   knob: {d['knob']}")
+        lines.append(f"   advice: {d['advice']}")
+    return "\n".join(lines)

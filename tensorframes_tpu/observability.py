@@ -45,6 +45,20 @@ The reference's observability is a Logging trait + log4j config + pervasive
   Prometheus text format; served as the bridge's ungated ``metrics``
   RPC and, with ``TFS_METRICS_PORT`` set, a stdlib-HTTP ``/metrics``
   endpoint (:func:`maybe_start_metrics_server`).
+* **request-scoped telemetry** (round 15) — a correlation context on a
+  ``contextvars.ContextVar``: :func:`request_ledger` (or the bridge
+  server, automatically per gated request) installs a
+  :class:`RequestLedger` that every counter bump, trace event, span,
+  and latency sample is attributed to WITHOUT perturbing the
+  process-global counters — the ledger mirrors the exact deltas, so a
+  single request's ledger matches ``counters_delta`` over its window
+  bit for bit.  Trace events carry the active ``cid`` (correlation
+  id), staging-lane worker threads inherit the context
+  (``prefetch.Prefetcher`` copies it), finished ledgers fold into
+  bounded-cardinality per-tenant ``tfs_request_*`` metrics, and
+  requests slower than ``TFS_SLOW_REQUEST_MS`` emit one structured
+  (JSON) log line.  With no active request the whole layer is one
+  contextvar read per block.
 
 Deliberately cheap: a disabled span is one ``if``; a counter bump is one
 dict increment under an uncontended lock (bridge handler threads bump
@@ -59,14 +73,18 @@ import collections
 import contextlib
 import contextvars
 import copy
+import itertools
 import json
 import logging
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+import uuid
+from typing import (
+    Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple,
+)
 
-from .envutil import env_int, warn_once
+from .envutil import env_float, env_int, warn_once
 
 logger = logging.getLogger("tensorframes_tpu")
 _verb_log = logging.getLogger("tensorframes_tpu.verbs")
@@ -140,10 +158,316 @@ _live_host_bytes = 0
 # is ~100ns on a path that is at most per-block, never per-element.
 _counters_lock = threading.Lock()
 
+# -- request-scoped telemetry (round 15) --------------------------------------
+#
+# One contextvar carries the active request's ledger; the bridge server
+# installs it per gated request (alongside the round-11 cancel scope) and
+# :func:`request_ledger` installs it for in-process callers.  Every
+# counter bump mirrors into the active ledger (same key, same delta), so
+# the ledger IS the counters-delta of its window, attributed to one
+# correlation id — the substrate multi-tenant accounting bills against.
+# Prefetch staging lanes copy the creating thread's context
+# (``prefetch.Prefetcher``), so bytes staged on a worker thread are
+# attributed to the request that staged them.  Ledger-off cost: one
+# contextvar read per bump / per block.
+
+ENV_SLOW_REQUEST_MS = "TFS_SLOW_REQUEST_MS"
+ENV_TENANT_LABELS = "TFS_TENANT_LABELS"
+DEFAULT_TENANT_LABELS = 16
+
+# per-ledger latency label bound: a ledger lives for one request, but a
+# request that touches many verbs must not grow an unbounded dict
+_LEDGER_LATENCY_LABELS = 32
+
+_request_ctx: "contextvars.ContextVar[Optional[RequestLedger]]" = (
+    contextvars.ContextVar("tfs_request_ledger", default=None)
+)
+
+
+# correlation ids are (random process prefix) + (atomic counter): unique
+# across processes and requests without paying uuid4's per-call urandom
+# syscall (~35 µs in containers with slow entropy paths — measured; the
+# id is minted per request AND per client call, so it sits on the
+# serving hot path).  itertools.count.__next__ is atomic under the GIL.
+_cid_prefix = uuid.uuid4().hex[:8]
+_cid_counter = itertools.count(1)
+
+
+def new_correlation_id() -> str:
+    """A fresh request correlation id (16 hex chars — compact enough
+    for trace-event args, unique enough for a process's attribution
+    window)."""
+    return f"{_cid_prefix}{next(_cid_counter) & 0xFFFFFFFF:08x}"
+
+
+class RequestLedger:
+    """Counters-delta-style resource attribution for ONE request.
+
+    Mirrors every counter bump made while the ledger is the active
+    request context — including bumps from prefetch staging lanes, which
+    inherit the context — so ``ledger.counters`` equals the
+    process-global :func:`counters_delta` over the request's window (bit
+    for bit when no other request runs concurrently; per-request exact
+    always, because each bump lands in exactly the ledgers active on its
+    thread).  Also tracks blocks/rows per device (the pool scheduler and
+    serial loops report them) and a bounded per-verb latency summary.
+
+    Ledgers NEST: a ledger constructed while another is active records
+    into both (``parent`` chaining), so e.g. an ``explain(analyze=True)``
+    run inside a bridge request never steals the outer request's
+    attribution."""
+
+    __slots__ = (
+        "correlation_id",
+        "tenant",
+        "method",
+        "parent",
+        "counters",
+        "blocks_per_device",
+        "rows",
+        "latency",
+        "wall_s",
+        "_t0",
+        "_lock",
+        "_finished",
+    )
+
+    def __init__(
+        self,
+        correlation_id: Optional[str] = None,
+        tenant: Optional[str] = None,
+        method: Optional[str] = None,
+    ):
+        self.correlation_id = correlation_id or new_correlation_id()
+        self.tenant = tenant
+        self.method = method
+        self.parent = _request_ctx.get()
+        self.counters: Dict[str, int] = {}
+        self.blocks_per_device: Dict[int, int] = {}
+        self.rows = 0
+        self.latency: Dict[str, Dict[str, Any]] = {}
+        self.wall_s: Optional[float] = None
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+        self._finished = False
+
+    # -- recording (called by the counter/latency layers) -------------------
+
+    def add(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+        if self.parent is not None:
+            self.parent.add(key, n)
+
+    def note_block(self, device: Optional[int] = 0, rows: int = 0) -> None:
+        d = int(device) if device is not None else 0
+        with self._lock:
+            self.blocks_per_device[d] = self.blocks_per_device.get(d, 0) + 1
+            self.rows += int(rows)
+        if self.parent is not None:
+            self.parent.note_block(device, rows)
+
+    def note_latency(self, kind: str, label: str, seconds: float) -> None:
+        key = f"{kind}:{label}"
+        with self._lock:
+            m = self.latency.get(key)
+            if m is None:
+                if len(self.latency) >= _LEDGER_LATENCY_LABELS:
+                    key = "other"
+                    m = self.latency.get(key)
+                if m is None:
+                    m = self.latency[key] = {
+                        "count": 0, "sum_s": 0.0, "max_s": 0.0
+                    }
+            m["count"] += 1
+            m["sum_s"] += seconds
+            if seconds > m["max_s"]:
+                m["max_s"] = seconds
+        if self.parent is not None:
+            self.parent.note_latency(kind, label, seconds)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def finish(self) -> None:
+        """Stamp the wall time, fold this request into the per-tenant
+        ``tfs_request_*`` metrics, and emit the slow-request structured
+        log when ``TFS_SLOW_REQUEST_MS`` is exceeded.  Idempotent."""
+        if self._finished:
+            return
+        self._finished = True
+        self.wall_s = time.perf_counter() - self._t0
+        # only ROOT ledgers fold into the per-tenant aggregates: a
+        # nested ledger (explain_analyze inside a bridge request)
+        # already mirrored every delta into its parent, so folding both
+        # would bill the same bytes twice and count one RPC as two
+        # requests
+        if self.parent is None:
+            _fold_request_metrics(self)
+        _maybe_log_slow_request(self)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """A JSON-safe copy of the ledger (the ``attribution`` RPC
+        payload and the slow-request log body)."""
+        with self._lock:
+            wall = (
+                self.wall_s
+                if self.wall_s is not None
+                else time.perf_counter() - self._t0
+            )
+            return {
+                "correlation_id": self.correlation_id,
+                "tenant": self.tenant,
+                "method": self.method,
+                "wall_s": round(wall, 6),
+                "counters": dict(self.counters),
+                "blocks_per_device": {
+                    str(d): n
+                    for d, n in sorted(self.blocks_per_device.items())
+                },
+                "rows": self.rows,
+                "latency": {
+                    k: {
+                        "count": v["count"],
+                        "sum_s": round(v["sum_s"], 6),
+                        "max_s": round(v["max_s"], 6),
+                    }
+                    for k, v in sorted(self.latency.items())
+                },
+            }
+
+
+def current_request() -> Optional[RequestLedger]:
+    """The active request's ledger, or None (one contextvar read)."""
+    return _request_ctx.get()
+
+
+def activate_request(ledger: RequestLedger):
+    """Install ``ledger`` as the active request context on this thread
+    (and, via context copy, on staging lanes it spawns).  Returns the
+    reset token for :func:`deactivate_request` — the split form the
+    bridge handler uses; in-process callers want
+    :func:`request_ledger`."""
+    return _request_ctx.set(ledger)
+
+
+def deactivate_request(token) -> None:
+    _request_ctx.reset(token)
+
+
+@contextlib.contextmanager
+def request_ledger(
+    correlation_id: Optional[str] = None,
+    tenant: Optional[str] = None,
+    method: Optional[str] = None,
+):
+    """Scope a :class:`RequestLedger` over a ``with`` body::
+
+        with observability.request_ledger(tenant="team-a") as led:
+            tfs.map_blocks(program, frame)
+        print(led.snapshot()["counters"]["h2d_bytes_staged"])
+
+    Everything the body executes — engine dispatch, staging lanes,
+    retries, cache traffic — is attributed to the ledger without
+    touching the process-global counters' meaning."""
+    led = RequestLedger(correlation_id, tenant=tenant, method=method)
+    token = activate_request(led)
+    try:
+        yield led
+    finally:
+        deactivate_request(token)
+        led.finish()
+
+
+def note_request_block(device: Optional[int] = 0, rows: int = 0) -> None:
+    """One block dispatched under the active request (serial loops call
+    this; pooled loops report through :func:`note_pool_dispatch`).  With
+    no active request this is ONE contextvar read — the ledger-off
+    hot-path cost contract."""
+    led = _request_ctx.get()
+    if led is not None:
+        led.note_block(device, rows)
+
+
+def slow_request_threshold_ms() -> float:
+    """``TFS_SLOW_REQUEST_MS`` (0 / unset = slow-request log off)."""
+    return env_float(ENV_SLOW_REQUEST_MS, 0.0)
+
+
+def _maybe_log_slow_request(led: RequestLedger) -> None:
+    th = slow_request_threshold_ms()
+    if th <= 0 or led.wall_s is None or led.wall_s * 1000.0 < th:
+        return
+    # ONE structured line: greppable prefix + machine-readable JSON body
+    logger.warning(
+        "slow_request %s",
+        json.dumps(led.snapshot(), sort_keys=True, default=str),
+    )
+
+
+# per-tenant request aggregates behind the ``tfs_request_*`` metric
+# families.  Label cardinality is BOUNDED (``TFS_TENANT_LABELS``): once
+# the cap is reached, new tenants fold into "other" — a long-lived
+# server's scrape size cannot grow with its tenant population.
+_request_agg: Dict[str, Dict[str, float]] = {}
+_request_agg_lock = threading.Lock()
+
+_REQUEST_AGG_FIELDS = (
+    "requests",
+    "slow",
+    "h2d_bytes",
+    "traces",
+    "retries",
+    "pool_blocks",
+    "shard_hits",
+    "wall_seconds",
+)
+
+
+def _fold_request_metrics(led: RequestLedger) -> None:
+    tenant = led.tenant or "default"
+    cap = env_int(ENV_TENANT_LABELS, DEFAULT_TENANT_LABELS, floor=1)
+    with led._lock:
+        c = dict(led.counters)
+    with _request_agg_lock:
+        agg = _request_agg.get(tenant)
+        if agg is None:
+            if len(_request_agg) >= cap and tenant != "other":
+                tenant = "other"
+                agg = _request_agg.get(tenant)
+            if agg is None:
+                agg = _request_agg[tenant] = {
+                    k: 0 for k in _REQUEST_AGG_FIELDS
+                }
+        agg["requests"] += 1
+        agg["wall_seconds"] += led.wall_s or 0.0
+        agg["h2d_bytes"] += c.get("h2d_bytes_staged", 0)
+        agg["traces"] += c.get("program_traces", 0)
+        agg["retries"] += c.get("block_retries", 0)
+        agg["pool_blocks"] += c.get("pool_blocks", 0)
+        agg["shard_hits"] += c.get("cache_shard_hits", 0)
+        th = slow_request_threshold_ms()
+        if th > 0 and (led.wall_s or 0.0) * 1000.0 >= th:
+            agg["slow"] += 1
+
+
+def request_metrics() -> Dict[str, Dict[str, float]]:
+    """Per-tenant request aggregates (a copy)."""
+    with _request_agg_lock:
+        return {t: dict(v) for t, v in _request_agg.items()}
+
+
+def reset_request_metrics() -> None:
+    """Drop the per-tenant aggregates (tests / bench legs)."""
+    with _request_agg_lock:
+        _request_agg.clear()
+
 
 def _bump(key: str, n: int = 1) -> None:
     with _counters_lock:
         _counters[key] += n
+    led = _request_ctx.get()
+    if led is not None:
+        led.add(key, n)
 
 # the verb currently executing on this thread (set by verb_span even when
 # spans are disabled, so counter attribution never depends on enable())
@@ -178,11 +502,16 @@ def note_program_trace() -> None:
     _verb_bump("program_traces")
 
 
-def note_pool_dispatch() -> None:
+def note_pool_dispatch(device: Optional[int] = None, rows: int = 0) -> None:
     """Called by the device-pool scheduler (``ops/device_pool.py``) once
     per block dispatched through the pool — the always-on counter that
-    lets a bench record prove pool utilisation rather than assert it."""
+    lets a bench record prove pool utilisation rather than assert it.
+    ``device``/``rows`` additionally attribute the block to the active
+    request's ledger (blocks-per-device accounting, round 15)."""
     _bump("pool_blocks")
+    led = _request_ctx.get()
+    if led is not None:
+        led.note_block(device, rows)
 
 
 def note_block_retry() -> None:
@@ -540,6 +869,12 @@ def trace_complete(
         "ts": round((t0 - e) * 1e6, 3),
         "dur": round(max(0.0, t1 - t0) * 1e6, 3),
     }
+    led = _request_ctx.get()
+    if led is not None and "cid" not in args:
+        # correlation (round 15): every event emitted under a request
+        # context carries its cid, so one Perfetto search strings a
+        # request's bridge/engine/staging/fault events together
+        args = dict(args, cid=led.correlation_id)
     if args:
         ev["args"] = args
     _trace_append(ev)
@@ -556,6 +891,9 @@ def trace_instant(name: str, track: str = "events", **args: Any) -> None:
         "track": track,
         "ts": round((time.perf_counter() - _trace_state["epoch"]) * 1e6, 3),
     }
+    led = _request_ctx.get()
+    if led is not None and "cid" not in args:
+        args = dict(args, cid=led.correlation_id)
     if args:
         ev["args"] = args
     _trace_append(ev)
@@ -668,45 +1006,70 @@ _LATENCY_BOUNDS = [
 ]
 
 
-class _LatencyHisto:
-    """One series' bucket counts + count/sum/max (no per-sample state)."""
+def _latency_quantile(
+    counts: Sequence[int], count: int, max_: float, q: float
+) -> float:
+    """Estimated ``q``-quantile over one series' state: linear
+    interpolation inside the bucket the rank lands in (the overflow
+    bucket interpolates up to the observed max)."""
+    if count == 0:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= target:
+            lo = _LATENCY_BOUNDS[i - 1] if i > 0 else 0.0
+            hi = (
+                _LATENCY_BOUNDS[i]
+                if i < len(_LATENCY_BOUNDS)
+                else max(max_, lo)
+            )
+            return lo + (hi - lo) * (target - cum) / c
+        cum += c
+    return max_
 
-    __slots__ = ("counts", "count", "sum", "max")
+
+class _LatencyHisto:
+    """One series' bucket counts + count/sum/max (no per-sample state).
+
+    Round-15 torn-read fix: each histogram carries its OWN lock.
+    ``record`` mutates four fields; before this round the global
+    ``_latency_lock`` covered both recording and the WHOLE scrape
+    render, so a scrape serialized every concurrent verb's latency
+    recording for its full duration — and any reader skipping the
+    global lock could observe a half-applied observation (count moved,
+    sum not yet).  Now recording takes only this lock, and readers copy
+    a consistent state tuple per series (:meth:`snapshot_state`) then
+    render outside all locks."""
+
+    __slots__ = ("lock", "counts", "count", "sum", "max")
 
     def __init__(self):
+        self.lock = threading.Lock()
         self.counts = [0] * (len(_LATENCY_BOUNDS) + 1)  # + overflow
         self.count = 0
         self.sum = 0.0
         self.max = 0.0
 
     def record(self, seconds: float) -> None:
-        self.counts[bisect.bisect_left(_LATENCY_BOUNDS, seconds)] += 1
-        self.count += 1
-        self.sum += seconds
-        if seconds > self.max:
-            self.max = seconds
+        with self.lock:
+            self.counts[bisect.bisect_left(_LATENCY_BOUNDS, seconds)] += 1
+            self.count += 1
+            self.sum += seconds
+            if seconds > self.max:
+                self.max = seconds
+
+    def snapshot_state(self) -> Tuple[List[int], int, float, float]:
+        """A consistent point-in-time copy of (counts, count, sum, max)
+        — no observation can be half-visible across the four fields."""
+        with self.lock:
+            return list(self.counts), self.count, self.sum, self.max
 
     def quantile(self, q: float) -> float:
-        """Estimated ``q``-quantile: linear interpolation inside the
-        bucket the rank lands in (the overflow bucket interpolates up to
-        the observed max)."""
-        if self.count == 0:
-            return 0.0
-        target = q * self.count
-        cum = 0
-        for i, c in enumerate(self.counts):
-            if c == 0:
-                continue
-            if cum + c >= target:
-                lo = _LATENCY_BOUNDS[i - 1] if i > 0 else 0.0
-                hi = (
-                    _LATENCY_BOUNDS[i]
-                    if i < len(_LATENCY_BOUNDS)
-                    else max(self.max, lo)
-                )
-                return lo + (hi - lo) * (target - cum) / c
-            cum += c
-        return self.max
+        counts, count, _, max_ = self.snapshot_state()
+        return _latency_quantile(counts, count, max_, q)
 
 
 _latency_lock = threading.Lock()
@@ -718,12 +1081,29 @@ _LATENCY_FAMILIES = {"verb": "verb", "bridge": "method"}
 
 
 def record_latency(kind: str, label: str, seconds: float) -> None:
-    """Record one observation into the ``(kind, label)`` series."""
+    """Record one observation into the ``(kind, label)`` series (and
+    into the active request's ledger, round 15)."""
     with _latency_lock:
         h = _latency.get((kind, label))
         if h is None:
             h = _latency[(kind, label)] = _LatencyHisto()
-        h.record(seconds)
+    h.record(seconds)
+    led = _request_ctx.get()
+    if led is not None:
+        led.note_latency(kind, label, seconds)
+
+
+def _latency_state() -> List[Tuple[str, str, List[int], int, float, float]]:
+    """A consistent snapshot of every series: the registry is copied
+    under the registry lock — so :func:`reset_latency`'s clear is atomic
+    with respect to any scrape (a scrape sees the whole pre-reset set or
+    none of it, never a half-cleared mix) — then each series' state is
+    copied under its own lock.  Rendering happens outside all locks."""
+    with _latency_lock:
+        items = sorted(_latency.items())
+    return [
+        (kind, label) + h.snapshot_state() for (kind, label), h in items
+    ]
 
 
 def latency_snapshot() -> Dict[str, Dict[str, Any]]:
@@ -731,22 +1111,23 @@ def latency_snapshot() -> Dict[str, Dict[str, Any]]:
     p50_s, p95_s, p99_s}, ...}`` — the programmatic face of the
     histograms (``metrics_text`` is the operator face)."""
     out: Dict[str, Dict[str, Any]] = {}
-    with _latency_lock:
-        for (kind, label), h in sorted(_latency.items()):
-            out[f"{kind}:{label}"] = {
-                "count": h.count,
-                "sum_s": round(h.sum, 6),
-                "max_s": round(h.max, 6),
-                "p50_s": round(h.quantile(0.50), 9),
-                "p95_s": round(h.quantile(0.95), 9),
-                "p99_s": round(h.quantile(0.99), 9),
-            }
+    for kind, label, counts, count, sum_, max_ in _latency_state():
+        out[f"{kind}:{label}"] = {
+            "count": count,
+            "sum_s": round(sum_, 6),
+            "max_s": round(max_, 6),
+            "p50_s": round(_latency_quantile(counts, count, max_, 0.50), 9),
+            "p95_s": round(_latency_quantile(counts, count, max_, 0.95), 9),
+            "p99_s": round(_latency_quantile(counts, count, max_, 0.99), 9),
+        }
     return out
 
 
 def reset_latency() -> None:
     """Drop every latency series (tests / bench legs metering their own
-    window)."""
+    window).  Atomic w.r.t. concurrent scrapes: readers copy the
+    registry under the same lock, so a scrape racing the reset renders
+    either the full pre-reset set or the empty post-reset one."""
     with _latency_lock:
         _latency.clear()
 
@@ -853,40 +1234,59 @@ def metrics_text(
         emitted.add(name)
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {_fmt_metric(gauges[name])}")
-    with _latency_lock:
-        by_kind: Dict[str, List[Tuple[str, _LatencyHisto]]] = {}
-        for (kind, label), h in sorted(_latency.items()):
-            by_kind.setdefault(kind, []).append((label, h))
-        for kind in sorted(by_kind):
-            fam = f"tfs_{kind}_latency_seconds"
-            lab = _LATENCY_FAMILIES.get(kind, "label")
-            lines.append(f"# TYPE {fam} histogram")
-            for label, h in by_kind[kind]:
-                sel = f'{lab}="{_escape_label(label)}"'
-                cum = 0
-                for i, cnt in enumerate(h.counts):
-                    cum += cnt
-                    le = (
-                        repr(_LATENCY_BOUNDS[i])
-                        if i < len(_LATENCY_BOUNDS)
-                        else "+Inf"
-                    )
-                    lines.append(
-                        f'{fam}_bucket{{{sel},le="{le}"}} {cum}'
-                    )
-                lines.append(f"{fam}_sum{{{sel}}} {repr(h.sum)}")
-                lines.append(f"{fam}_count{{{sel}}} {h.count}")
-            qfam = f"tfs_{kind}_latency_quantile_seconds"
-            lines.append(f"# TYPE {qfam} gauge")
-            for label, h in by_kind[kind]:
-                sel = f'{lab}="{_escape_label(label)}"'
-                for qname, q in (
-                    ("p50", 0.50), ("p95", 0.95), ("p99", 0.99)
-                ):
-                    lines.append(
-                        f'{qfam}{{{sel},q="{qname}"}} '
-                        f"{repr(h.quantile(q))}"
-                    )
+    # per-tenant request attribution (round 15): bounded-cardinality
+    # labelled families fed by finished RequestLedgers
+    req = request_metrics()
+    if req:
+        for field in _REQUEST_AGG_FIELDS:
+            fam = f"tfs_request_{field}_total"
+            if fam in emitted:
+                continue  # defensive: never emit a duplicate family
+            emitted.add(fam)
+            lines.append(f"# TYPE {fam} counter")
+            for tenant in sorted(req):
+                lines.append(
+                    f'{fam}{{tenant="{_escape_label(tenant)}"}} '
+                    f"{_fmt_metric(req[tenant][field])}"
+                )
+    # latency histograms: rendered from consistent per-series snapshots
+    # (round 15 — no lock is held while formatting, so a scrape cannot
+    # serialize concurrent verbs' record_latency calls)
+    by_kind: Dict[str, List[Tuple[str, List[int], int, float, float]]] = {}
+    for kind, label, counts, count, sum_, max_ in _latency_state():
+        by_kind.setdefault(kind, []).append(
+            (label, counts, count, sum_, max_)
+        )
+    for kind in sorted(by_kind):
+        fam = f"tfs_{kind}_latency_seconds"
+        lab = _LATENCY_FAMILIES.get(kind, "label")
+        lines.append(f"# TYPE {fam} histogram")
+        for label, counts, count, sum_, max_ in by_kind[kind]:
+            sel = f'{lab}="{_escape_label(label)}"'
+            cum = 0
+            for i, cnt in enumerate(counts):
+                cum += cnt
+                le = (
+                    repr(_LATENCY_BOUNDS[i])
+                    if i < len(_LATENCY_BOUNDS)
+                    else "+Inf"
+                )
+                lines.append(
+                    f'{fam}_bucket{{{sel},le="{le}"}} {cum}'
+                )
+            lines.append(f"{fam}_sum{{{sel}}} {repr(sum_)}")
+            lines.append(f"{fam}_count{{{sel}}} {count}")
+        qfam = f"tfs_{kind}_latency_quantile_seconds"
+        lines.append(f"# TYPE {qfam} gauge")
+        for label, counts, count, sum_, max_ in by_kind[kind]:
+            sel = f'{lab}="{_escape_label(label)}"'
+            for qname, q in (
+                ("p50", 0.50), ("p95", 0.95), ("p99", 0.99)
+            ):
+                lines.append(
+                    f'{qfam}{{{sel},q="{qname}"}} '
+                    f"{repr(_latency_quantile(counts, count, max_, q))}"
+                )
     return "\n".join(lines) + "\n"
 
 
@@ -1047,6 +1447,11 @@ class _Span:
     def __init__(self, verb: str, meta: Dict[str, Any]):
         self.verb = verb
         self.meta = meta
+        led = _request_ctx.get()
+        if led is not None:
+            # request correlation (round 15): the span record names the
+            # request it ran under, like every trace event does
+            meta.setdefault("cid", led.correlation_id)
         self.phases: Dict[str, float] = {}
         # snapshot UNDER the counters lock: bridge handler threads (and
         # pool lane fallbacks) bump concurrently, and an unlocked

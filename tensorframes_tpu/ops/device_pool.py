@@ -308,8 +308,10 @@ class PoolRun:
     def note_dispatch(self, di: int, n_rows: int) -> None:
         """Record one block dispatched to device ``di`` (used directly by
         the reduce verbs, whose partials stay on device instead of going
-        through the readback window)."""
-        observability.note_pool_dispatch()
+        through the readback window).  The device index and row count
+        ride into the active request's ledger (round 15) so per-request
+        attribution carries blocks-per-device."""
+        observability.note_pool_dispatch(di, n_rows)
         if self._first_dispatch[di] is None:
             self._first_dispatch[di] = time.perf_counter()
         self.blocks[di] += 1

@@ -763,6 +763,10 @@ class Executor:
                     # bit-identical to the exact-shape path)
                     outs = {k: v[:n_rows] for k, v in outs.items()}
             self._check_block_outputs(program, outs, n_rows, rows_level, trim)
+            # request attribution (round 15): one contextvar read per
+            # block when no ledger is active — the documented hot-path
+            # cost of the attribution layer on the serial loop
+            observability.note_request_block(0, n_rows)
             observability.trace_complete(
                 f"{verb} b{bi}", "serial", t_blk, block=bi, rows=n_rows
             )
@@ -2074,6 +2078,7 @@ class Executor:
                     partials.append(
                         session.run(bi, sizes[bi], attempt, device=0)
                     )
+                observability.note_request_block(0, sizes[bi])
                 observability.trace_complete(
                     f"reduce b{bi}", "serial", t_blk,
                     block=bi, rows=sizes[bi],

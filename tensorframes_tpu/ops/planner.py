@@ -70,6 +70,7 @@ from __future__ import annotations
 import collections
 import logging
 import os
+import time
 import weakref
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
@@ -1143,6 +1144,11 @@ class LazyFrame:
     def explain_plan(self) -> str:
         return explain_plan(self)
 
+    def explain_analyze(self) -> str:
+        """Execute the plan under a request ledger and render the
+        measured report (``tfs.explain(frame, analyze=True)``)."""
+        return explain_analyze(self)
+
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
@@ -1220,6 +1226,44 @@ def _flush(
     return frame
 
 
+def _measured(fn, rows: int) -> Tuple[Any, Dict[str, Any]]:
+    """Run ``fn()`` and return ``(result, measurement)`` — wall time and
+    the resource deltas every plan record carries (round 15: the
+    substance behind ``tfs.explain(frame, analyze=True)``).
+
+    Metered through a nested :class:`observability.RequestLedger`, NOT
+    a global counters-delta window: the ledger is exact per thread
+    (staging lanes inherit the context), so a concurrent request in the
+    same process cannot contaminate a stage's h2d/trace attribution.
+    The ledger is deliberately never ``finish()``-ed — internal stage
+    metering must not fold into the per-tenant request aggregates or
+    the slow-request log (an enclosing bridge request's ledger still
+    sees every delta via parent chaining)."""
+    led = observability.RequestLedger(method="plan_stage")
+    token = observability.activate_request(led)
+    t0 = time.perf_counter()
+    try:
+        out = fn()
+    finally:
+        observability.deactivate_request(token)
+    wall = time.perf_counter() - t0
+    c = led.snapshot()["counters"]
+    m: Dict[str, Any] = {
+        "wall_s": round(wall, 6),
+        "h2d_bytes": c.get("h2d_bytes_staged", 0),
+        "traces": c.get("program_traces", 0),
+        "rows": rows,
+        "rows_per_s": round(rows / wall, 1) if wall > 0 else None,
+    }
+    if c.get("pool_blocks"):
+        m["pool_blocks"] = c["pool_blocks"]
+    if c.get("cache_shard_hits"):
+        m["shard_hits"] = c["cache_shard_hits"]
+    if c.get("block_retries"):
+        m["retries"] = c["block_retries"]
+    return out, m
+
+
 def _dispatch_single(
     node: LazyFrame,
     frame: TensorFrame,
@@ -1228,12 +1272,17 @@ def _dispatch_single(
     reason: str,
 ) -> TensorFrame:
     st = node._step
-    if st.kind == "map_rows":
-        out = _DEFAULT.map_rows(st.program, frame, host_stage=st.host_stage)
-    else:
-        out = _DEFAULT.map_blocks(
+
+    def run():
+        if st.kind == "map_rows":
+            return _DEFAULT.map_rows(
+                st.program, frame, host_stage=st.host_stage
+            )
+        return _DEFAULT.map_blocks(
             st.program, frame, trim=st.trim, host_stage=st.host_stage
         )
+
+    out, measured = _measured(run, frame.num_rows)
     node._runs += 1
     records.append(
         {
@@ -1242,6 +1291,7 @@ def _dispatch_single(
             "fused": 1,
             "dispatch": "eager",
             "reason": reason,
+            **measured,
         }
     )
     return out
@@ -1264,10 +1314,22 @@ def _dispatch_fused(
         devices = (
             cache.devices if cache is not None else device_pool.pool_devices()
         )
-        out, run_rec = _run_pooled_chain(meta, frame, cache, devices)
+        (out, run_rec), measured = _measured(
+            lambda: _run_pooled_chain(meta, frame, cache, devices),
+            frame.num_rows,
+        )
         rec.update(run_rec)
+        # the observed payoff of the pool decision: measured per-device
+        # occupancy collapses to an effective-parallelism scalar the
+        # analyze rendering reports next to the decision's reason
+        occ = run_rec.get("device_pool", {}).get("occupancy")
+        if occ:
+            measured["effective_parallelism"] = round(sum(occ), 2)
     else:
-        out = _run_serial_chain(steps, frame)
+        out, measured = _measured(
+            lambda: _run_serial_chain(steps, frame), frame.num_rows
+        )
+    rec.update(measured)
     observability.note_plan_fused_dispatch()
     if meta.pruned:
         observability.note_plan_columns_pruned(len(meta.pruned))
@@ -1424,3 +1486,95 @@ def explain_plan(frame: LazyFrame) -> str:
                 f"(reason={r['reason']}{extra})"
             )
     return "\n".join(lines)
+
+
+def _render_analyze(frame: LazyFrame, executed_now: bool) -> str:
+    """The measured half of ``explain(analyze=True)``: per-group wall
+    time, bytes staged, pool occupancy, and the pool-vs-serial decision
+    with its observed payoff — rendered from the per-stage measurements
+    every plan execution records."""
+    recs = frame._last_records
+    lines = ["== analyze (measured) =="]
+    if not executed_now:
+        lines.append(
+            "(plan was already materialized; measurements are from its "
+            "last execution)"
+        )
+    if not recs:
+        lines.append("(no recorded execution — the plan has no stages)")
+    tot_wall = 0.0
+    tot_h2d = 0
+    for r in recs:
+        wall = r.get("wall_s")
+        tot_wall += wall or 0.0
+        tot_h2d += r.get("h2d_bytes") or 0
+        head = (
+            f" group stage {r['stage']}: {r['verb']} "
+            f"[{'fused x' + str(r['fused']) if r.get('fused', 1) >= 2 else 'eager'}]"
+        )
+        lines.append(head)
+        lines.append(
+            f"   dispatch={r.get('dispatch')} (reason={r.get('reason')})"
+            + (
+                f" intensity={r['intensity_flops_per_byte']}"
+                if r.get("intensity_flops_per_byte") is not None
+                else ""
+            )
+        )
+        lines.append(
+            f"   wall={wall}s  h2d_bytes={r.get('h2d_bytes')}  "
+            f"traces={r.get('traces')}  rows/s={r.get('rows_per_s')}"
+        )
+        dp = r.get("device_pool")
+        if dp:
+            payoff = r.get("effective_parallelism")
+            lines.append(
+                f"   pool: blocks={dp.get('blocks_per_device')} "
+                f"occupancy={dp.get('occupancy')}"
+                + (
+                    f" -> observed payoff: {payoff}x effective "
+                    f"parallelism across {dp.get('devices')} device(s)"
+                    if payoff is not None
+                    else ""
+                )
+            )
+        if r.get("retries"):
+            lines.append(f"   retries={r['retries']}")
+        if r.get("pruned"):
+            lines.append(f"   pruned={r['pruned']}")
+    lines.append(
+        f" totals: wall={round(tot_wall, 6)}s  h2d_bytes={tot_h2d}"
+    )
+    led = getattr(frame, "_last_ledger", None)
+    if led:
+        c = led.get("counters", {})
+        lines.append(
+            f" request: cid={led.get('correlation_id')} "
+            f"wall={led.get('wall_s')}s "
+            f"h2d={c.get('h2d_bytes_staged', 0)} "
+            f"traces={c.get('program_traces', 0)} "
+            f"retries={c.get('block_retries', 0)} "
+            f"blocks_per_device={led.get('blocks_per_device')}"
+        )
+    return "\n".join(lines)
+
+
+def explain_analyze(frame: LazyFrame) -> str:
+    """``EXPLAIN ANALYZE`` for a planned frame: execute the plan under a
+    :func:`observability.request_ledger` (nesting safely inside any
+    active bridge request's ledger) and render the logical plan PLUS the
+    measured per-stage/per-group report — wall time, bytes staged, pool
+    occupancy, and each pool-vs-serial decision with its observed
+    payoff.  A plan that already materialized renders its last
+    execution's measurements (plans memoize; re-deriving the chain from
+    the source re-executes)."""
+    executed_now = frame._materialized is None
+    with observability.request_ledger(method="explain_analyze") as led:
+        frame._materialize(count_use=False)
+    if executed_now:
+        frame._last_ledger = led.snapshot()
+    return (
+        explain_plan(frame)
+        + "\n"
+        + _render_analyze(frame, executed_now)
+    )

@@ -64,6 +64,7 @@ carries over unchanged because only host-fresh frames ever pool.
 
 from __future__ import annotations
 
+import contextvars
 import os
 import queue
 import threading
@@ -266,8 +267,17 @@ class Prefetcher:
                 # re-raise with block context (StagingError)
                 put((None, (i, e)))
 
+        # request-scoped telemetry (round 15): the worker runs under a
+        # COPY of the consumer thread's context, so counter bumps made
+        # while staging (``note_h2d_bytes`` inside ``device_put`` paths)
+        # and the lane's trace events are attributed to the request that
+        # staged them — without this, a ledger's h2d accounting would
+        # miss exactly the bytes the staging lanes move.  Cancellation
+        # semantics are unchanged: staging code never calls
+        # ``cancellation.checkpoint()``, so the copied scope is inert.
+        ctx = contextvars.copy_context()
         t = threading.Thread(
-            target=worker, name=self._name, daemon=True
+            target=lambda: ctx.run(worker), name=self._name, daemon=True
         )
         t.start()
         try:

@@ -88,6 +88,26 @@ class _MergingSpan:
         self._span.annotate(key, value)
 
 
+def _frame_bytes(frame: TensorFrame) -> int:
+    """Host/device byte size of a window frame's columns — the data
+    volume a Perfetto ``stream`` track event carries (round-15
+    satellite: duration alone cannot distinguish a slow small window
+    from a fast huge one).  Ragged columns sum their cells; anything
+    unsized counts zero rather than failing a trace emission."""
+    total = 0
+    for c in frame.columns:
+        nb = getattr(c.data, "nbytes", None)
+        if nb is None:
+            try:
+                nb = sum(
+                    int(getattr(cell, "nbytes", 0)) for cell in c.cells()
+                )
+            except Exception:  # noqa: BLE001 — tracing must never raise
+                nb = 0
+        total += int(nb)
+    return total
+
+
 def _annotate(span, stream: StreamFrame, windows: int, rows: int) -> None:
     span.annotate(
         "streaming",
@@ -125,6 +145,7 @@ def _drain_to_sink(outputs, sink, span_name: str, stream: StreamFrame):
                 observability.trace_complete(
                     f"window {windows}", "stream", t_win,
                     window=windows, rows=out.num_rows,
+                    bytes=_frame_bytes(out) if t_win is not None else 0,
                 )
                 windows += 1
                 rows += out.num_rows
@@ -266,6 +287,7 @@ def _reduce_stream(program, stream: StreamFrame, mode, engine, verb: str):
             observability.trace_complete(
                 f"window {windows}", "stream", t_win,
                 window=windows, rows=wf.num_rows,
+                bytes=_frame_bytes(wf) if t_win is not None else 0,
             )
             windows += 1
             rows += wf.num_rows
@@ -362,6 +384,7 @@ def aggregate(
             observability.trace_complete(
                 f"window {windows}", "stream", t_win,
                 window=windows, rows=wf.num_rows,
+                bytes=_frame_bytes(wf) if t_win is not None else 0,
             )
             windows += 1
             rows += wf.num_rows

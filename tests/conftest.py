@@ -79,6 +79,16 @@ os.environ.setdefault("TFS_TRACE", "0")
 os.environ.setdefault("TFS_TRACE_EVENTS", "")
 os.environ.setdefault("TFS_METRICS_PORT", "")
 
+# Request-scoped telemetry (round 15): the slow-request structured log
+# stays OFF in the main suite (a log line per test request is noise and
+# some tests assert on captured logs), and the tenant-label cap keeps
+# its default.  Absence-defaults like every TFS_* pin above: the
+# attribution tier (run_tests.sh) exports TFS_SLOW_REQUEST_MS live, and
+# tests drive thresholds via monkeypatch.  The ledger layer itself
+# needs no pin — with no active request it is one contextvar read.
+os.environ.setdefault("TFS_SLOW_REQUEST_MS", "")
+os.environ.setdefault("TFS_TENANT_LABELS", "")
+
 # Lazy verb-graph planner (round 14, ops/planner.py) stays OFF in the
 # main suite: with TFS_PLAN=1 every module-level map verb returns a
 # LazyFrame and defers dispatch, which would change when (and how many

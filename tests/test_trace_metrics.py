@@ -285,6 +285,69 @@ def test_metrics_http_endpoint():
         observability.stop_metrics_server()
 
 
+def test_metrics_http_endpoint_concurrent_scrapes():
+    """Round-15 satellite: the TFS_METRICS_PORT endpoint under
+    concurrent scrapers racing verb execution, latency recording, and
+    reset_latency — every response must be 200 with a consistently
+    parseable body (no duplicate TYPE families, no torn histograms),
+    and no handler thread may raise."""
+    import threading
+    import urllib.request
+
+    httpd = observability.start_metrics_server(0)
+    errors: list = []
+    stop = threading.Event()
+    try:
+        host, port = httpd.server_address[:2]
+        url = f"http://{host}:{port}/metrics"
+
+        def scrape(n):
+            try:
+                for _ in range(n):
+                    body = urllib.request.urlopen(url, timeout=10).read()
+                    text = body.decode()
+                    fams = [
+                        ln.split()[2]
+                        for ln in text.splitlines()
+                        if ln.startswith("# TYPE")
+                    ]
+                    assert len(fams) == len(set(fams)), "dup family"
+                    assert "tfs_program_traces_total" in text
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                observability.record_latency(
+                    "verb", f"scrape_churn{i % 3}", 0.001
+                )
+                if i % 50 == 0:
+                    observability.reset_latency()
+                i += 1
+
+        churner = threading.Thread(target=churn, daemon=True)
+        churner.start()
+        scrapers = [
+            threading.Thread(target=scrape, args=(10,)) for _ in range(6)
+        ]
+        for t in scrapers:
+            t.start()
+        # scrape-during-verb-execution: real dispatches while scraping
+        for _ in range(3):
+            tfs.map_blocks(lambda x: {"z": x + 1.0}, _frame(64, 4))
+        for t in scrapers:
+            t.join(60)
+        stop.set()
+        churner.join(10)
+        assert not any(t.is_alive() for t in scrapers), "scraper hung"
+        assert not errors, errors
+    finally:
+        stop.set()
+        observability.stop_metrics_server()
+        observability.reset_latency()
+
+
 def test_bridge_metrics_rpc_and_health_gauges():
     from tensorframes_tpu.bridge import BridgeClient, serve
 

@@ -59,6 +59,20 @@ TFS_BRIDGE_DRAIN_S=5 TFS_BRIDGE_MAX_FRAMES=256 \
 JAX_PLATFORMS=cpu \
   python -m pytest tests/test_bridge_resilience.py tests/test_bridge.py -q
 
+# Serving tier: the round-16 multi-tenant throughput tests (request
+# coalescing, warm program pools, SLO scheduler, continuous decode
+# batching) re-run with the coalescer + warm knobs LIVE on the forced
+# 8-device host — the main suite runs the same file with conftest
+# pinning the env knobs off (tests pass explicit constructor params
+# there); this tier proves the env wiring end to end, pooled coalesced
+# dispatch included.
+echo "== serving tier (coalescer + warm pool, env knobs live) =="
+TFS_BRIDGE_COALESCE_US=20000 TFS_BRIDGE_COALESCE_ROWS=4096 \
+TFS_BRIDGE_WARM=8 TFS_BRIDGE_CLIENT_BUSY_RETRIES=2 \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_bridge_coalesce.py -q
+
 # Streaming tier: the out-of-core streaming tests re-run with the
 # TFS_STREAM_*/TFS_SPILL_DIR/TFS_HOST_BUDGET knobs LIVE (tmpdir spill +
 # parquet fixtures) — the main suite runs them too, but with conftest

@@ -25,6 +25,12 @@ cooperatively at block boundaries, bounded admission with ``ServerBusy``
 shedding, token-addressed sessions with idempotent retry after dropped
 replies, graceful drain, and an ungated ``health`` RPC (see
 ``docs/RESILIENCE.md``).
+
+Round 16 adds the multi-tenant THROUGHPUT layer (``docs/SERVING.md``):
+request coalescing into bucket-canonical micro-batches over a warm
+program pool (``Coalescer`` / ``WarmPool``), SLO-aware fair-share
+admission (``SloScheduler``), and continuous decode batching
+(``ContinuousBatcher``).
 """
 
 from .client import (
@@ -36,6 +42,13 @@ from .client import (
     RemoteFrame,
     ServerBusy,
 )
+from .coalescer import (
+    Coalescer,
+    ContinuousBatcher,
+    SloScheduler,
+    WarmPool,
+    WarmSpec,
+)
 from .server import BridgeServer, serve
 
 __all__ = [
@@ -43,9 +56,14 @@ __all__ = [
     "BridgeError",
     "BridgeServer",
     "Cancelled",
+    "Coalescer",
+    "ContinuousBatcher",
     "DeadlineExceeded",
     "Draining",
     "RemoteFrame",
     "ServerBusy",
+    "SloScheduler",
+    "WarmPool",
+    "WarmSpec",
     "serve",
 ]

@@ -29,9 +29,14 @@ Client-side resilience (round 11):
   token-addressed server-side (``hello``), so the reconnected client
   reattaches to the same frames.
 * **Structured refusals**: admission sheds raise :class:`ServerBusy`
-  (carrying ``retry_after_ms``) or :class:`Draining`; these are server
-  *decisions*, not connection failures, and are never auto-retried here
-  — routing around a busy server is the caller's policy.
+  (carrying ``retry_after_ms``) or :class:`Draining`.  With
+  ``busy_retries`` (``TFS_BRIDGE_CLIENT_BUSY_RETRIES``, default 0) set,
+  the retry loop HONORS the server's ``retry_after_ms`` hint (round-16
+  satellite): a shed gated call sleeps exactly the hinted backoff and
+  re-sends — never past the call's deadline, and never for ``Draining``
+  (a draining server wants you gone, not back).  At 0 the pre-round-16
+  behavior stands: sheds surface immediately and routing is the
+  caller's policy.
 """
 
 from __future__ import annotations
@@ -53,6 +58,7 @@ logger = logging.getLogger("tensorframes_tpu.bridge.client")
 
 ENV_CLIENT_TIMEOUT_S = "TFS_BRIDGE_CLIENT_TIMEOUT_S"
 ENV_CLIENT_RETRIES = "TFS_BRIDGE_CLIENT_RETRIES"
+ENV_CLIENT_BUSY_RETRIES = "TFS_BRIDGE_CLIENT_BUSY_RETRIES"
 
 DEFAULT_RECONNECT_RETRIES = 3
 DEFAULT_BACKOFF_S = 0.05
@@ -178,6 +184,7 @@ class BridgeClient:
         jitter: float = 1.0,
         rng=None,
         tenant: Optional[str] = None,
+        busy_retries: Optional[int] = None,
     ):
         self._host = host
         self._port = int(port)
@@ -202,6 +209,9 @@ class BridgeClient:
                 ENV_CLIENT_RETRIES, DEFAULT_RECONNECT_RETRIES
             )
         self._retries = int(reconnect_retries)
+        if busy_retries is None:
+            busy_retries = env_int(ENV_CLIENT_BUSY_RETRIES, 0)
+        self._busy_retries = int(busy_retries)
         self._backoff_s = float(backoff_s)
         self._jitter = float(jitter)
         self._rng = rng
@@ -350,6 +360,7 @@ class BridgeClient:
         # ``last_correlation_id`` with an id the ``attribution`` RPC
         # can never find (e.g. the attribution lookup itself)
         cid = None if safe else observability.new_correlation_id()
+        busy_left = 0 if safe else self._busy_retries
         with self._lock:
             if cid is not None:
                 self.last_correlation_id = cid
@@ -484,7 +495,37 @@ class BridgeClient:
                     continue
                 rbins = resp.pop("_bins")
                 if "error" in resp:
-                    _raise_remote(resp["error"])
+                    err = resp["error"]
+                    if (
+                        err.get("code") == "server_busy"
+                        and busy_left > 0
+                    ):
+                        # honor the server's retry_after_ms hint (round
+                        # 16): the shed was never executed or cached, so
+                        # re-sending the SAME idem token + cid keeps the
+                        # retry a continuation of this logical call.
+                        # Never sleep past the deadline — surfacing the
+                        # shed beats converting it into a silent
+                        # deadline_exceeded.
+                        delay = (
+                            float(err.get("retry_after_ms", 50)) / 1e3
+                        )
+                        if deadline_end is not None and (
+                            time.monotonic() + delay >= deadline_end
+                        ):
+                            _raise_remote(err)
+                        busy_left -= 1
+                        logger.debug(
+                            "bridge call %s shed (server_busy); "
+                            "honoring retry_after_ms=%s (%d busy "
+                            "retries left)",
+                            method,
+                            err.get("retry_after_ms"),
+                            busy_left,
+                        )
+                        time.sleep(delay)
+                        continue
+                    _raise_remote(err)
                 return decode_value(resp["result"], rbins)
 
     def close(self) -> None:
@@ -559,6 +600,37 @@ class BridgeClient:
         delta, blocks/rows per device, per-verb latency, wall time;
         without one returns the server's recent ledgers, newest last."""
         return self.call("attribution", correlation_id=correlation_id)
+
+    def warm(
+        self,
+        graph: bytes,
+        fetches: Sequence[str],
+        columns: Mapping[str, Any],
+        rows: Optional[Sequence[int]] = None,
+        verb: str = "map_rows",
+        inputs: Optional[Mapping[str, str]] = None,
+        shapes: Optional[Mapping[str, Sequence[int]]] = None,
+        trim: bool = False,
+        deadline_ms: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Register + AOT-prime a program on the server (round 16):
+        the warm pool keeps it resident and ``Executor.warmup`` compiles
+        its ``(bucket, device)`` executable grid for the given block row
+        counts, so the first real request is a jit-cache hit.
+        ``columns`` maps column name -> a small sample array (dtype +
+        cell shape are read; values are ignored)."""
+        return self.call(
+            "warm",
+            deadline_ms=deadline_ms,
+            graph=graph,
+            fetches=list(fetches),
+            inputs=dict(inputs or {}),
+            shapes=dict(shapes or {}),
+            trim=trim,
+            verb=verb,
+            columns={k: np.asarray(v) for k, v in columns.items()},
+            rows=[int(r) for r in (rows or [])],
+        )
 
     def create_frame(
         self,

@@ -65,8 +65,10 @@ from ..envutil import env_float as _env_float, env_int as _env_int
 from ..analyze import analyze as _analyze
 from ..builder import OpBuilder
 from ..frame import TensorFrame
-from ..ops import device_pool, frame_cache
+from ..ops import bucketing, device_pool, frame_cache
+from ..ops import engine as _engine_mod
 from ..ops.engine import GroupedFrame
+from . import coalescer as _coalescer
 from .protocol import (
     PROTOCOL_VERSION,
     decode_value,
@@ -115,6 +117,9 @@ _GATED_METHODS = frozenset(
         "reduce_blocks",
         "reduce_rows",
         "collect",
+        # round 16: registers + AOT-primes a program's (bucket, device)
+        # executable grid — it compiles, so it pays admission like a verb
+        "warm",
     }
 )
 
@@ -892,6 +897,40 @@ class _Handler(socketserver.StreamRequestHandler):
         # token, and waiters are woken even when admission refuses
         entry = None
         try:
+            # SLO-aware admission policy (round 16) BEFORE the gate: an
+            # over-budget tenant (or the dominant consumer under tail
+            # pressure) is shed with a structured hint instead of
+            # queueing into the very backlog that blows p99.  Only the
+            # BILLED compute verbs are subject to it — shedding a cheap
+            # metadata call (create_frame/analyze) frees nothing and
+            # just burns the tenant's retries.
+            decision = (
+                server.scheduler.check(
+                    getattr(
+                        observability.current_request(), "tenant", None
+                    ),
+                    contention=(
+                        server.gate.max_inflight > 0
+                        and (
+                            server.gate.queued > 0
+                            or server.gate.inflight
+                            >= server.gate.max_inflight
+                        )
+                    ),
+                )
+                if method in server._BILLED_METHODS
+                else None
+            )
+            if decision is not None:
+                observability.note_bridge_shed()
+                raise ServerBusy(
+                    f"{method} shed by the SLO scheduler "
+                    f"({decision['reason']}: tenant "
+                    f"{decision['tenant']!r} used "
+                    f"{decision.get('rows_used', 0)} rows in the "
+                    f"window)",
+                    **decision,
+                )
             # flight recorder: admission wait and execution are separate
             # events on this handler's track, so queueing-vs-compute time
             # is visible per request in the Perfetto view
@@ -917,16 +956,22 @@ class _Handler(socketserver.StreamRequestHandler):
                         with cancellation.activate(scope):
                             scope.check()  # deadline may have passed queued
                             observability.note_bridge_verb_executed()
-                            if method in (
-                                "map_blocks",
-                                "map_rows",
-                                "aggregate",
-                            ):
+                            if method in ("map_blocks", "map_rows"):
+                                # round 16: map verbs route through the
+                                # coalescer (warm program pool + micro-
+                                # batching); solo when coalescing is off
+                                result = server.coalescer.run_map_verb(
+                                    sess, method, scope=scope, **params
+                                )
+                            elif method == "aggregate":
                                 result = sess.run_df_verb(method, **params)
                             elif method in ("reduce_blocks", "reduce_rows"):
                                 result = sess.run_row_verb(method, **params)
+                            elif method == "warm":
+                                result = server.warm_program(**params)
                             else:  # create_frame / analyze / collect
                                 result = getattr(sess, method)(**params)
+                            server._note_usage(sess, method, params)
                         reply, bins = self._encode_result(method, result)
                         entry = ("result", reply["result"], bins)
                     except Exception as e:  # noqa: BLE001 — structured
@@ -1002,6 +1047,12 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         drain_s: Optional[float] = None,
         max_frames: Optional[int] = None,
         session_ttl_s: Optional[float] = None,
+        coalesce_us: Optional[float] = None,
+        coalesce_rows: Optional[int] = None,
+        warm_spec: Optional[str] = None,
+        fair_rows: Optional[int] = None,
+        fair_window_s: Optional[float] = None,
+        slo_ms: Optional[float] = None,
     ):
         if not allow_remote and host not in ("127.0.0.1", "::1", "localhost"):
             raise ValueError(
@@ -1032,6 +1083,26 @@ class BridgeServer(socketserver.ThreadingTCPServer):
             _env_float(ENV_SESSION_TTL_S, DEFAULT_SESSION_TTL_S)
             if session_ttl_s is None
             else float(session_ttl_s)
+        )
+        # round 16 — the serving throughput layer: request coalescing
+        # over a warm program pool, and the SLO-aware admission policy
+        # consulted BEFORE the gate (fair-share row budgets + proactive
+        # tail shedding).  Knobs come from the env unless constructor
+        # overrides are passed (like every other bridge knob).
+        self.coalescer = _coalescer.Coalescer(
+            engine=engine,
+            wait_us=coalesce_us,
+            max_rows=coalesce_rows,
+            warm=_coalescer.WarmPool(
+                _coalescer.WarmSpec.from_env(warm_spec)
+                if warm_spec is not None
+                else None
+            ),
+            register_scope=self._register_scope,
+            unregister_scope=self._unregister_scope,
+        )
+        self.scheduler = _coalescer.SloScheduler(
+            fair_rows=fair_rows, window_s=fair_window_s, slo_ms=slo_ms
         )
         self._sessions: Dict[str, _Session] = {}
         self._sessions_lock = threading.Lock()
@@ -1068,6 +1139,10 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         # duplicate TYPE family.
         self._gauge_providers = {
             "tfs_bridge_admission": self._admission_gauges,
+            # round 16: coalescer queue depth / open programs / warm-pool
+            # residency — ONE grouped provider per the round-13 rule
+            # (one snapshot per scrape, no counter-name collisions)
+            "tfs_bridge_coalescer": self.coalescer.gauges,
         }
         for name, fn in self._gauge_providers.items():
             observability.register_gauge(name, fn)
@@ -1160,6 +1235,144 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         with self._scopes_lock:
             self._scopes.discard(scope)
 
+    # -- serving throughput layer (round 16) ---------------------------------
+
+    # methods whose rows bill the tenant's fair-share window: the
+    # compute/data-moving verbs.  Metadata ops (create_frame, analyze,
+    # warm) are not usage — billing them would charge a tenant for
+    # DESCRIBING work it never ran.
+    _BILLED_METHODS = frozenset(
+        {
+            "map_blocks",
+            "map_rows",
+            "aggregate",
+            "reduce_blocks",
+            "reduce_rows",
+            "collect",
+        }
+    )
+
+    def _note_usage(self, sess: _Session, method: str, params) -> None:
+        """Bill an executed gated request's rows to its tenant's
+        fair-share window (frame-addressed compute verbs only; the rows
+        are the INPUT frame's — the work the request put on the
+        machine)."""
+        if not self.scheduler.enabled():
+            return
+        if method not in self._BILLED_METHODS:
+            return
+        fid = params.get("frame_id") if isinstance(params, dict) else None
+        if fid is None:
+            return
+        frame = sess.frames.get(fid)
+        if frame is None:
+            return
+        led = observability.current_request()
+        self.scheduler.note(
+            led.tenant if led is not None else None, frame.num_rows
+        )
+
+    def warm_program(
+        self,
+        graph=None,
+        fetches=None,
+        inputs=None,
+        shapes=None,
+        verb: str = "map_rows",
+        trim: bool = False,
+        columns=None,
+        rows=None,
+    ) -> Dict[str, Any]:
+        """The gated ``warm`` RPC (round 16): register the program in
+        the warm pool and AOT-prime its ``(bucket, device)`` executable
+        grid via ``Executor.warmup`` — backed by the persistent compile
+        cache (``TFS_COMPILE_CACHE``), so a restarted server's priming
+        is a disk fetch, and the first real request pays neither the
+        GraphDef import nor the compile.
+
+        ``columns`` maps column name -> a small sample array (>= 0 rows;
+        only dtype + cell shape are read); ``rows`` lists the block row
+        counts to prime (default: the ``TFS_BRIDGE_WARM`` spec's
+        ``buckets``)."""
+        if verb not in ("map_rows", "map_blocks"):
+            raise BridgeServerError(
+                f"warm supports the map verbs, not {verb!r}",
+                code="bad_request",
+            )
+        if not columns:
+            raise BridgeServerError(
+                "warm needs columns={name: sample array} to learn the "
+                "schema it should prime",
+                code="bad_request",
+            )
+        sizes = [int(r) for r in (rows or []) if int(r) > 0]
+        if not sizes:
+            sizes = [
+                b for b in self.coalescer.warm.spec.buckets if b > 0
+            ]
+        if not sizes:
+            raise BridgeServerError(
+                f"warm needs rows=[...] (or buckets in {_coalescer.ENV_WARM})",
+                code="bad_request",
+            )
+        _, ent, hit = self.coalescer.warm.entry(
+            verb, graph, fetches, inputs, shapes, trim
+        )
+        ex = _engine_mod._resolve(self.engine)
+        n_lanes = (
+            len(device_pool.pool_devices())
+            if device_pool.enabled()
+            else 1
+        )
+        fps = []
+        for r in sizes:
+            cols = {}
+            for name, sample in columns.items():
+                arr = np.asarray(sample)
+                cols[name] = np.zeros(
+                    (r * max(1, n_lanes),) + arr.shape[1:], arr.dtype
+                )
+            frame = TensorFrame.from_arrays(
+                cols, num_blocks=max(1, n_lanes)
+            )
+            fps.extend(
+                ex.warmup(
+                    ent.program, frame, rows_level=(verb == "map_rows")
+                )
+            )
+            # Executor.warmup primes the (bucket, device) grid for
+            # POOL/cached topologies; a single-default-device server
+            # (the common serving child) still needs the dispatch
+            # entry's jit cache seeded by one real execution — programs
+            # are pure by contract, so a zeros dispatch has no effect
+            # beyond the caches, and trace counting is suppressed
+            # (warmup is analysis, not traffic)
+            with observability.suppress_trace_count():
+                warm_frame = TensorFrame.from_arrays(
+                    {
+                        name: np.zeros(
+                            (r,) + np.asarray(s).shape[1:],
+                            np.asarray(s).dtype,
+                        )
+                        for name, s in columns.items()
+                    },
+                    num_blocks=1,
+                )
+                if verb == "map_rows":
+                    ex.map_rows(ent.program, warm_frame)
+                else:
+                    ex.map_blocks(ent.program, warm_frame, trim=trim)
+        return {
+            "primed_rows": sizes,
+            "buckets": sorted(
+                {bucketing.bucket_for(r) for r in sizes}
+            ),
+            "executables": len(set(fps)),
+            "devices": max(1, n_lanes),
+            "warm_hit": hit,
+            "resident": len(self.coalescer.warm),
+        }
+
     # -- health --------------------------------------------------------------
 
     def health_snapshot(self) -> Dict[str, Any]:
@@ -1184,6 +1397,11 @@ class BridgeServer(socketserver.ThreadingTCPServer):
                 "budget_bytes": frame_cache.hbm_budget(),
                 "resident_bytes": frame_cache.budget_bytes_resident(),
             },
+            # round 16: coalescer + SLO-scheduler state (queue depth per
+            # program, batch-size histogram, warm-pool residency,
+            # per-tenant window usage) for serving dashboards/balancers
+            "coalescer": self.coalescer.snapshot(),
+            "scheduler": self.scheduler.snapshot(),
             "counters": {
                 k: c[k]
                 for k in (
@@ -1193,6 +1411,12 @@ class BridgeServer(socketserver.ThreadingTCPServer):
                     "bridge_idem_hits",
                     "bridge_verbs_executed",
                     "devices_quarantined",
+                    "coalesced_batches",
+                    "coalesced_requests",
+                    "coalesce_solo_requests",
+                    "warm_program_hits",
+                    "fair_share_sheds",
+                    "slo_sheds",
                 )
             },
             # round 13: the gauge snapshot serving operators need
@@ -1311,7 +1535,9 @@ def serve(
     thread and returns immediately (``server.address`` has the bound
     port).  ``server_kw`` forwards the resilience knobs
     (``max_inflight``, ``queue_depth``, ``drain_s``, ``max_frames``,
-    ``session_ttl_s``) past their env defaults."""
+    ``session_ttl_s``) and the round-16 serving knobs (``coalesce_us``,
+    ``coalesce_rows``, ``warm_spec``, ``fair_rows``, ``fair_window_s``,
+    ``slo_ms``) past their env defaults."""
     server = BridgeServer(
         host, port, engine=engine, allow_remote=allow_remote, **server_kw
     )

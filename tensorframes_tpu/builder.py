@@ -31,6 +31,54 @@ from .ops.engine import Executor, GroupedFrame
 from .program import Program, ProgramError
 
 
+def compile_program(
+    source: Any,
+    fetches: Optional[Sequence[str]] = None,
+    inputs: Optional[Mapping[str, str]] = None,
+    shapes: Optional[Mapping[str, Sequence[int]]] = None,
+    outputs: Optional[Mapping[str, str]] = None,
+    is_graphdef: Optional[bool] = None,
+    what: str = "program",
+) -> Program:
+    """Build a :class:`Program` from any accepted source — GraphDef
+    bytes, a python function, DSL nodes, or an existing Program — with
+    the builder's feed/fetch/shape-hint semantics.  This is the one
+    program-construction path shared by :class:`OpBuilder` and the
+    bridge's warm program pool (``bridge/coalescer.py``), so a program
+    built once can be cached and reused across requests instead of
+    re-importing the GraphDef per call."""
+    if is_graphdef is None:
+        is_graphdef = isinstance(source, (bytes, bytearray))
+    if is_graphdef:
+        from .graphdef import import_graphdef
+
+        if not fetches:
+            raise ProgramError(
+                f"{what}: GraphDef programs need fetches before build"
+            )
+        program = import_graphdef(
+            source,
+            fetches=list(fetches),
+            inputs=dict(inputs) if inputs else None,
+            outputs=dict(outputs) if outputs else None,
+        )
+    else:
+        if outputs:
+            raise ProgramError(
+                "outputs renames apply to GraphDef programs only"
+            )
+        program = Program.wrap(
+            source, list(fetches) if fetches else fetches,
+            dict(inputs) if inputs else None,
+        )
+    if shapes:
+        # the ShapeDescription override: hints refine engine-inferred
+        # shapes in analyze() and are checked against real outputs at
+        # run time (contradictions raise)
+        program = program.with_shape_hints(shapes)
+    return program
+
+
 class OpBuilder:
     """Accumulates program source + hints for one verb invocation.
 
@@ -145,33 +193,15 @@ class OpBuilder:
                 f"{self._verb} builder: no graph attached; call .graph(...) "
                 f"or .graph_from_file(...)"
             )
-        if self._is_graphdef:
-            from .graphdef import import_graphdef
-
-            if not self._fetches:
-                raise ProgramError(
-                    "GraphDef programs need .fetches([...]) before build"
-                )
-            program = import_graphdef(
-                self._source,
-                fetches=self._fetches,
-                inputs=self._feed or None,
-                outputs=self._out_renames or None,
-            )
-        else:
-            if self._out_renames:
-                raise ProgramError(
-                    "outputs renames apply to GraphDef programs only"
-                )
-            program = Program.wrap(
-                self._source, self._fetches, self._feed or None
-            )
-        if self._shapes:
-            # the ShapeDescription override: hints refine engine-inferred
-            # shapes in analyze() and are checked against real outputs at
-            # run time (contradictions raise)
-            program = program.with_shape_hints(self._shapes)
-        return program
+        return compile_program(
+            self._source,
+            fetches=self._fetches,
+            inputs=self._feed or None,
+            shapes=self._shapes or None,
+            outputs=self._out_renames or None,
+            is_graphdef=self._is_graphdef,
+            what=self._verb,
+        )
 
     def build_df(self) -> TensorFrame:
         """Run a frame-returning verb (``buildDF``,

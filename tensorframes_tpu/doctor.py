@@ -38,10 +38,20 @@ Rules (each fires at most one diagnostic):
   usually retrace storms, retries, or admission queueing surfaced
   upstream; pair with the matching diagnostic and per-request
   attribution (``attribution`` RPC) to find the victims.
+* **coalesce_miss** (round 16) — requests keep dispatching ALONE on hot
+  programs: the coalescer's gather window is too short (or coalescing
+  is off) for the arrival rate, so the shared-executable micro-batching
+  win is being left on the table.  Raise ``TFS_BRIDGE_COALESCE_US``.
+* **unfair_tenant** (round 16) — one tenant's row share dwarfs every
+  other's over the ``tfs_request_*`` window while the server is
+  shedding or queueing: the hog is starving the small tenants.  Set
+  ``TFS_BRIDGE_FAIR_ROWS`` so the SLO scheduler enforces per-tenant
+  budgets.
 
 Every input is injectable (``counters=``, ``latency=``, ``ledger=``,
-``spans=``) so tests and offline analysis run the same rules over
-recorded snapshots; with no arguments the live process state is read.
+``spans=``, ``tenants=``) so tests and offline analysis run the same
+rules over recorded snapshots; with no arguments the live process
+state is read.
 ``doctor()`` returns the diagnostics as a list of dicts —
 ``{code, severity, summary, evidence, knob, advice}`` — and
 ``render()`` formats them for humans.
@@ -62,6 +72,8 @@ RETRACE_RATIO = 0.5  # traces per invocation past warmup
 OCCUPANCY_FLOOR = 0.5  # mean pooled occupancy below this is "idle"
 SHED_RATE = 0.10
 TAIL_RATIO = 32.0  # p99 / p50
+COALESCE_MISS_RATE = 0.5  # solo dispatches / coalescer-eligible requests
+UNFAIR_ROW_RATIO = 4.0  # top tenant rows vs the runner-up
 
 
 def _diag(
@@ -299,11 +311,90 @@ def _rule_slow_tail(latency) -> Optional[Dict[str, Any]]:
     )
 
 
+def _rule_coalesce_miss(c) -> Optional[Dict[str, Any]]:
+    solo = c.get("coalesce_solo_requests", 0)
+    batched = c.get("coalesced_requests", 0)
+    hot = c.get("warm_program_hits", 0)
+    if solo < MIN_EVENTS:
+        return None
+    offered = solo + batched
+    rate = solo / offered
+    if rate < COALESCE_MISS_RATE:
+        return None
+    return _diag(
+        "coalesce_miss",
+        "warn" if rate >= 0.9 else "info",
+        f"{solo} of {offered} coalescer-eligible requests ({rate:.0%}) "
+        f"dispatched ALONE on hot programs ({hot} warm-pool hits) — "
+        f"the gather window keeps expiring before company arrives",
+        {"coalesce_solo_requests": solo, "coalesced_requests": batched,
+         "warm_program_hits": hot, "solo_rate": round(rate, 3)},
+        "TFS_BRIDGE_COALESCE_US",
+        "raise TFS_BRIDGE_COALESCE_US so concurrent small requests on "
+        "the same program merge into one bucket-canonical dispatch "
+        "(each batch amortizes staging + dispatch across its members); "
+        "a window near the inter-arrival gap captures most of the win "
+        "for at most one window of added latency",
+    )
+
+
+def _rule_unfair_tenant(c, tenants) -> Optional[Dict[str, Any]]:
+    if not tenants or len(tenants) < 2:
+        return None
+    rows = {
+        t: int(v.get("rows", 0))
+        for t, v in tenants.items()
+        if v.get("requests", 0) > 0
+    }
+    if len(rows) < 2 or sum(rows.values()) == 0:
+        return None
+    ranked = sorted(rows.items(), key=lambda kv: -kv[1])
+    (top, top_rows), (_, second_rows) = ranked[0], ranked[1]
+    total_req = sum(int(v.get("requests", 0)) for v in tenants.values())
+    if total_req < MIN_EVENTS:
+        return None
+    if top_rows < UNFAIR_ROW_RATIO * max(1, second_rows):
+        return None
+    # starvation needs CONTENTION evidence: someone was shed or queued
+    # while the hog ran — imbalance alone on an idle server is fine
+    shed = c.get("bridge_shed", 0)
+    fair = c.get("fair_share_sheds", 0)
+    if shed + fair == 0:
+        return None
+    if fair > 0:
+        # the budget knob is already enforcing; report as info so the
+        # operator sees WHO is being throttled, not as a missing knob
+        sev, advice = "info", (
+            "TFS_BRIDGE_FAIR_ROWS is enforcing: the over-budget tenant "
+            "is being shed with retry_after_ms hints; raise its budget "
+            "(or add capacity) if the throttling is unintended"
+        )
+    else:
+        sev, advice = "warn", (
+            "set TFS_BRIDGE_FAIR_ROWS (per-tenant rows per "
+            "TFS_BRIDGE_FAIR_WINDOW_S window) so the SLO scheduler "
+            "sheds the hog with a backoff hint BEFORE the admission "
+            "queue fills and p99 blows for everyone else"
+        )
+    return _diag(
+        "unfair_tenant",
+        sev,
+        f"tenant {top!r} consumed {top_rows} rows — "
+        f"{top_rows / max(1, second_rows):.0f}x the next tenant's "
+        f"{second_rows} — while {shed + fair} request(s) were shed",
+        {"rows_by_tenant": rows, "top_tenant": top,
+         "bridge_shed": shed, "fair_share_sheds": fair},
+        "TFS_BRIDGE_FAIR_ROWS",
+        advice,
+    )
+
+
 def doctor(
     counters: Optional[Mapping[str, Any]] = None,
     latency: Optional[Mapping[str, Mapping[str, Any]]] = None,
     ledger: Optional[Mapping[str, Any]] = None,
     spans: Optional[Sequence[Mapping[str, Any]]] = None,
+    tenants: Optional[Mapping[str, Mapping[str, Any]]] = None,
 ) -> List[Dict[str, Any]]:
     """Diagnose the process's (or the given snapshots') performance
     state.  Returns structured diagnostics, worst first — each names
@@ -316,13 +407,17 @@ def doctor(
     ``ledger`` takes a :meth:`RequestLedger.snapshot` (or an
     ``attribution`` RPC body) to scope the pool-skew rule to one
     request; ``spans`` takes :func:`observability.last_spans` records
-    for measured pool occupancy."""
+    for measured pool occupancy; ``tenants`` takes
+    :func:`observability.request_metrics` (or the server's
+    ``tfs_request_*`` scrape) for the fairness rule."""
     c = dict(counters if counters is not None else observability.counters())
     lat = dict(
         latency if latency is not None else observability.latency_snapshot()
     )
     if spans is None:
         spans = observability.last_spans(64)
+    if tenants is None:
+        tenants = observability.request_metrics()
     out: List[Dict[str, Any]] = []
     for rule in (
         lambda: _rule_shed_burn(c),
@@ -331,6 +426,8 @@ def doctor(
         lambda: _rule_cache_thrash(c),
         lambda: _rule_low_pool_occupancy(c, ledger, spans),
         lambda: _rule_retry_burn(c),
+        lambda: _rule_unfair_tenant(c, tenants),
+        lambda: _rule_coalesce_miss(c),
         lambda: _rule_slow_tail(lat),
     ):
         d = rule()

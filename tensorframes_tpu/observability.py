@@ -144,6 +144,18 @@ _counters: Dict[str, int] = {
     "plan_fused_dispatches": 0,
     "plan_columns_pruned": 0,
     "plan_cache_inserts": 0,
+    # multi-tenant serving throughput (round 16, bridge/coalescer.py):
+    # micro-batches dispatched, requests they carried, requests that
+    # dispatched ALONE on a hot program (the coalesce_miss evidence),
+    # warm program-pool traffic, and SLO-scheduler sheds by reason
+    "coalesced_batches": 0,
+    "coalesced_requests": 0,
+    "coalesced_rows": 0,
+    "coalesce_solo_requests": 0,
+    "warm_program_hits": 0,
+    "warm_program_misses": 0,
+    "fair_share_sheds": 0,
+    "slo_sheds": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
 
@@ -266,6 +278,31 @@ class RequestLedger:
             self.rows += int(rows)
         if self.parent is not None:
             self.parent.note_block(device, rows)
+
+    def absorb(
+        self,
+        counters: Optional[Mapping[str, int]] = None,
+        blocks_per_device: Optional[Mapping[int, int]] = None,
+        rows: int = 0,
+    ) -> None:
+        """Fold an externally-apportioned share into this ledger — the
+        bridge coalescer's attribution path (round 16): one shared
+        dispatch runs under a private batch ledger, and each
+        participating request absorbs its exact row share of the batch's
+        counters/blocks so the shares SUM to the batch's global delta."""
+        with self._lock:
+            for k, n in (counters or {}).items():
+                if n:
+                    self.counters[k] = self.counters.get(k, 0) + int(n)
+            for d, n in (blocks_per_device or {}).items():
+                if n:
+                    d = int(d)
+                    self.blocks_per_device[d] = (
+                        self.blocks_per_device.get(d, 0) + int(n)
+                    )
+            self.rows += int(rows)
+        if self.parent is not None:
+            self.parent.absorb(counters, blocks_per_device, rows)
 
     def note_latency(self, kind: str, label: str, seconds: float) -> None:
         key = f"{kind}:{label}"
@@ -419,6 +456,7 @@ _REQUEST_AGG_FIELDS = (
     "retries",
     "pool_blocks",
     "shard_hits",
+    "rows",
     "wall_seconds",
 )
 
@@ -445,6 +483,7 @@ def _fold_request_metrics(led: RequestLedger) -> None:
         agg["retries"] += c.get("block_retries", 0)
         agg["pool_blocks"] += c.get("pool_blocks", 0)
         agg["shard_hits"] += c.get("cache_shard_hits", 0)
+        agg["rows"] += led.rows
         th = slow_request_threshold_ms()
         if th > 0 and (led.wall_s or 0.0) * 1000.0 >= th:
             agg["slow"] += 1
@@ -597,6 +636,45 @@ def note_bridge_verb_executed() -> None:
     """One admission-gated bridge method actually executed (dedup hits
     and shed requests never bump this)."""
     _bump("bridge_verbs_executed")
+
+
+def note_coalesced_batch(requests: int, rows: int) -> None:
+    """One coalesced micro-batch dispatched by the bridge coalescer
+    (``bridge/coalescer.py``) carrying ``requests`` requests totalling
+    ``rows`` rows.  A batch of one request counts as a *solo* dispatch
+    instead (:func:`note_coalesce_solo`) — the split feeds the
+    ``coalesce_miss`` doctor rule."""
+    if requests <= 1:
+        note_coalesce_solo()
+        return
+    _bump("coalesced_batches")
+    _bump("coalesced_requests", requests)
+    _bump("coalesced_rows", rows)
+
+
+def note_coalesce_solo() -> None:
+    """One request that reached the coalescer but dispatched alone
+    (nobody else arrived within ``TFS_BRIDGE_COALESCE_US``)."""
+    _bump("coalesce_solo_requests")
+
+
+def note_warm_program(hit: bool) -> None:
+    """One warm-program-pool lookup by the bridge (hit = the compiled
+    Program was resident; miss = it was rebuilt from GraphDef bytes)."""
+    _bump("warm_program_hits" if hit else "warm_program_misses")
+
+
+def note_fair_share_shed() -> None:
+    """The SLO scheduler shed a request for exceeding its tenant's
+    fair-share row budget under contention."""
+    _bump("fair_share_sheds")
+
+
+def note_slo_shed() -> None:
+    """The SLO scheduler shed a request because the serving p99 was
+    approaching ``TFS_BRIDGE_SLO_MS`` and the tenant was the dominant
+    row consumer."""
+    _bump("slo_sheds")
 
 
 def note_plan_fused_dispatch() -> None:
@@ -758,6 +836,14 @@ def counters_delta(
             "plan_fused_dispatches",
             "plan_columns_pruned",
             "plan_cache_inserts",
+            "coalesced_batches",
+            "coalesced_requests",
+            "coalesced_rows",
+            "coalesce_solo_requests",
+            "warm_program_hits",
+            "warm_program_misses",
+            "fair_share_sheds",
+            "slo_sheds",
         )
     }
 

@@ -132,6 +132,19 @@ def bucket_for(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def coalesced_blocks(total_rows: int, n_lanes: int) -> int:
+    """Block count for a coalesced micro-batch (``bridge/coalescer.py``):
+    spread the combined rows over up to ``n_lanes`` device-pool lanes,
+    but never deal a block below the minimum bucket — sub-bucket blocks
+    would all pad to ``_MIN_BUCKET`` anyway and just multiply dispatch
+    overhead.  The resulting blocks land on the SAME geometric ladder as
+    every other verb (``bucket_for``), so concurrent tenants' batches
+    share hot executables regardless of who arrived together."""
+    if n_lanes <= 1 or total_rows <= _MIN_BUCKET:
+        return 1
+    return max(1, min(int(n_lanes), total_rows // _MIN_BUCKET))
+
+
 def pad_rows(arr, target: int):
     """Pad ``arr``'s lead axis up to ``target`` rows by repeating the
     edge (last) row.  Host arrays pad in numpy (cheap, runs on the

@@ -89,6 +89,22 @@ os.environ.setdefault("TFS_METRICS_PORT", "")
 os.environ.setdefault("TFS_SLOW_REQUEST_MS", "")
 os.environ.setdefault("TFS_TENANT_LABELS", "")
 
+# Multi-tenant serving throughput layer (round 16, bridge/coalescer.py)
+# stays OFF in the main suite: coalescing merges concurrent requests
+# into shared dispatches (changing trace/compile counts and span stats
+# the fences pin), the warm program pool reuses Program objects across
+# requests (same effect), and the SLO scheduler sheds by policy.  The
+# coalescer tests pass explicit BridgeServer constructor params;
+# run_tests.sh's serving tier re-runs them with the env knobs live.
+# Absence-defaults (setdefault), not hard pins, like every TFS_* above.
+os.environ.setdefault("TFS_BRIDGE_COALESCE_US", "")
+os.environ.setdefault("TFS_BRIDGE_COALESCE_ROWS", "")
+os.environ.setdefault("TFS_BRIDGE_WARM", "")
+os.environ.setdefault("TFS_BRIDGE_FAIR_ROWS", "")
+os.environ.setdefault("TFS_BRIDGE_FAIR_WINDOW_S", "")
+os.environ.setdefault("TFS_BRIDGE_SLO_MS", "")
+os.environ.setdefault("TFS_BRIDGE_CLIENT_BUSY_RETRIES", "")
+
 # Lazy verb-graph planner (round 14, ops/planner.py) stays OFF in the
 # main suite: with TFS_PLAN=1 every module-level map verb returns a
 # LazyFrame and defers dispatch, which would change when (and how many

@@ -5,6 +5,23 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+# Lint tier (round 17): the AST repo-invariant checker (knob routing /
+# pinning / docs, counter declaration, checkpoint coverage) plus the
+# static-analysis differential corpus — TFS_ANALYZE_XCHECK=1 runs the
+# classifier AND the per-size compile probe on every row-independence
+# question and raises on any analyzer-says-independent/probe-disproves
+# disagreement, over the analysis test corpus (the main suite runs the
+# same file with the xcheck pinned off).  `lint` as $1 runs ONLY this
+# tier (fast pre-commit gate; skips the native build below).
+echo "== lint tier (repo invariants + analysis xcheck corpus) =="
+python tools/tfs_lint.py
+TFS_ANALYZE_XCHECK=1 JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_analysis.py -q
+if [ "${1:-}" = "lint" ]; then
+  echo "lint tier passed"
+  exit 0
+fi
+
 echo "== building native extension (optional) =="
 python -m tensorframes_tpu.native.build || echo "native build failed; numpy fallback will be used"
 

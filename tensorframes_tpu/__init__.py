@@ -18,7 +18,8 @@ The user-facing module mirrors the reference's python API
     s = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, tf)
 """
 
-from . import compile_cache, dsl, faults, observability, resilience
+from . import analysis, compile_cache, dsl, faults, observability, resilience
+from .analysis import check
 from .analyze import analyze, explain, print_schema
 from .doctor import doctor
 from .builder import OpBuilder
@@ -71,6 +72,8 @@ def map_blocks_trimmed(fn, frame, **kw):
 
 
 __all__ = [
+    "analysis",
+    "check",
     "compile_cache",
     "dsl",
     "block",

@@ -1,0 +1,89 @@
+"""tensorframes_tpu.analysis — static program analysis (round 17).
+
+The reference's distinguishing subsystem is the *analysis* pass: columns
+are annotated with tensor shapes and the graph is validated against the
+schema **before** any executor runs (``TensorFlowOps.analyzeGraphTF``,
+PAPER.md §0).  Rounds 1–16 inverted that: correctness properties were
+discovered at dispatch time by compile probes, and contract violations
+surfaced scattered and late (some only after a compile).  This package
+closes the gap with three layers:
+
+* :mod:`.rowdep` — **size-generic row-independence classification**: one
+  abstract-interpretation pass over a program's jaxpr classifies every
+  output as ``ROW_INDEPENDENT`` / ``CROSS_ROW`` / ``SIZE_DEPENDENT`` /
+  ``UNKNOWN`` once per (program, input signature), so the five
+  row-independence gates (engine streaming/bucketing/OOM-split, pipeline
+  chain pads, planner chain pads, bridge coalescer, dist pad+mask)
+  answer new size questions with ZERO probe traces.  The per-size
+  compile probe (``segment_compile.cached_rows_independent``) remains
+  the soundness oracle: verdict ``UNKNOWN`` falls back to it, and
+  ``TFS_ANALYZE_XCHECK=1`` runs both and raises on any
+  analyzer-says-independent / probe-disproves disagreement.
+* :mod:`.contracts` — **pre-dispatch contract verification**:
+  ``tfs.check(frame, program, verb)`` statically validates feeds /
+  fetches / dtypes / ragged compatibility / reduce-monoid and
+  decode-prelude constraints / GraphDef imports into structured
+  diagnostics ``{code, severity, summary, location, advice}`` with
+  stable ``TFSxxx`` codes (see ``docs/ANALYSIS.md``).  The bridge's
+  ungated ``check`` RPC serves it remotely so tenants validate before
+  burning admission budget.
+* ``tools/tfs_lint.py`` — the **repo self-lint** enforcing the
+  cross-cutting invariants this codebase promises (knob routing/pinning
+  /docs, counter declaration, checkpoint coverage); wired as
+  ``run_tests.sh lint``.
+
+Import discipline: :mod:`.rowdep` is imported eagerly (the engine depends
+on it); :mod:`.contracts` pulls the verb/builder layers, so ``check`` is
+re-exported lazily to keep ``ops`` <-> ``analysis`` import order acyclic.
+"""
+
+from __future__ import annotations
+
+from .rowdep import (  # noqa: F401
+    CROSS_ROW,
+    ROW_INDEPENDENT,
+    SIZE_DEPENDENT,
+    UNKNOWN,
+    AnalysisXCheckError,
+    Classification,
+    classify,
+    enabled,
+    input_specs_for,
+    rows_independent,
+    xcheck_enabled,
+)
+
+__all__ = [
+    "ROW_INDEPENDENT",
+    "CROSS_ROW",
+    "SIZE_DEPENDENT",
+    "UNKNOWN",
+    "AnalysisXCheckError",
+    "Classification",
+    "classify",
+    "enabled",
+    "xcheck_enabled",
+    "rows_independent",
+    "input_specs_for",
+    "check",
+    "Diagnostic",
+    "CODES",
+]
+
+
+def check(*args, **kwargs):
+    """Pre-dispatch contract verification — see
+    :func:`tensorframes_tpu.analysis.contracts.check`.  Lazy so importing
+    the analysis core (engine dependency) never drags the builder layer
+    in and cycles the ``ops`` import."""
+    from . import contracts
+
+    return contracts.check(*args, **kwargs)
+
+
+def __getattr__(name):
+    if name in ("Diagnostic", "CODES"):
+        from . import contracts
+
+        return getattr(contracts, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

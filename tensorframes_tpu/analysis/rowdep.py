@@ -1,0 +1,554 @@
+"""Size-generic row-independence classification (the analysis core).
+
+Every fast path that reshapes a block's lead axis — bucketing's
+pad-and-slice, chunked h2d streaming, the OOM block split, pipeline /
+planner chain pads, the coalescer's merged dispatch, dist's pad+mask —
+is gated on one question: *is this program row-independent?* (each output
+row a function of the same input row only).  Rounds 4–16 answered it
+with a compile probe, :func:`segment_compile.rows_independent_at`, posed
+at the EXACT executed sizes — sound, but paid per (signature, sizes)
+key: every new bucket signature re-traces the program at least twice.
+
+This module answers it **once per (program, input signature)** with an
+abstract-interpretation pass over the program's jaxpr: the program is
+traced at the canonical probe sizes and each variable is propagated
+through a small label lattice, batching-rule style::
+
+    const < row < size < cross        (+ unresolved)
+
+* ``const`` — derived from trace constants / params only;
+* ``row``   — lead axis is the row axis and every row depends only on
+  the same row of the inputs (the probe's "row" class);
+* ``size``  — the VALUE tracks the block size (a count literal family
+  like ``mean``'s ``/n``, or an n-tracking parameter on a non-shape
+  primitive): padding would change semantics at any size;
+* ``cross`` — rows mix (a block-axis reduction, a primitive outside the
+  row-independence whitelist, a constant broadcast onto the row axis —
+  everything the probe structurally rejects).
+
+Each program *output* classifies as :data:`ROW_INDEPENDENT`,
+:data:`CROSS_ROW`, :data:`SIZE_DEPENDENT` or :data:`UNKNOWN`; the
+program-level verdict is the meet (a single non-independent output, or
+any whitelist violation anywhere in the jaxpr — mirroring the probe's
+global strictness — makes the program non-independent).
+
+Soundness contract: a verdict other than ``UNKNOWN`` is only issued when
+the same answer is *forced* for every size set the probe could be posed
+at — definitive negatives come from size-monotone evidence (whitelist
+membership is size-independent; count families and n-tracking params
+are strictly monotone in n, so no two distinct sizes can make them
+coincide), and ``ROW_INDEPENDENT`` replicates the probe's acceptance
+conditions at the canonical probes.  Anything ambiguous (structure that
+varies across the probes — python control flow branching on the block
+size — unresolvable literals, non-monotone shape classes) is
+``UNKNOWN`` and falls back to the per-size probe, which stays the
+soundness oracle.  Residual envelope, shared with the segment
+recognizer's ``_PROBES`` (segment_compile.py): a program whose python
+control flow branches only beyond the largest canonical probe (97) is
+outside the classifier's view; ``TFS_ANALYZE_XCHECK=1`` runs classifier
+AND probe on every question and raises :class:`AnalysisXCheckError` on
+any analyzer-says-independent / probe-disproves disagreement, which is
+the differential fence ``run_tests.sh lint`` drives over the corpus.
+
+Knobs (``docs/ANALYSIS.md``): ``TFS_ANALYZE`` (unset/``auto``/``1`` =
+on, ``0``/``off`` = every question probes as before) and
+``TFS_ANALYZE_XCHECK`` (differential mode).  Evidence counters:
+``analysis_static_hits`` / ``analysis_probe_fallbacks``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import dtypes, envutil, observability
+from ..ops import segment_compile
+from ..ops.segment_compile import (
+    _Bail,
+    _ELEMENTWISE,
+    _REDUCE_KINDS,
+    _SHAPEY,
+    _fit_family,
+    _match_param,
+    _trace,
+)
+
+logger = logging.getLogger("tensorframes_tpu.analysis")
+
+# program-output verdicts (the public classification alphabet)
+ROW_INDEPENDENT = "ROW_INDEPENDENT"
+CROSS_ROW = "CROSS_ROW"
+SIZE_DEPENDENT = "SIZE_DEPENDENT"
+UNKNOWN = "UNKNOWN"
+
+ENV_ANALYZE = "TFS_ANALYZE"
+ENV_XCHECK = "TFS_ANALYZE_XCHECK"
+
+# canonical classification probes: 2+3+5 pin the row/cell dims and the
+# count-literal families, 97 catches python control flow branching on
+# the block size at small thresholds — the same envelope (and the same
+# residual assumption) as the segment recognizer's _PROBES
+_ANALYZE_PROBES = (2, 3, 5, 97)
+
+_OFF_TOKENS = ("0", "off", "false", "no", "none")
+_TRUTHY = ("1", "true", "yes", "on")
+
+# internal label lattice (join = max rank); None = unresolved
+_RANK = {"const": 0, "row": 1, "size": 2, "cross": 3}
+
+
+class AnalysisXCheckError(AssertionError):
+    """Differential mode caught the classifier claiming ROW_INDEPENDENT
+    where the exact-size compile probe disproves it — an analyzer bug
+    (or a program outside the documented probe envelope); file the
+    jaxpr, do not ship the classification."""
+
+
+def enabled() -> bool:
+    """Whether the static classifier answers row-independence questions
+    (``TFS_ANALYZE``; on unless explicitly disabled).  Read per call:
+    bench A/B legs and tests flip it mid-process."""
+    return envutil.env_raw(ENV_ANALYZE).lower() not in _OFF_TOKENS
+
+
+def xcheck_enabled() -> bool:
+    """Whether every classifier answer is differentially checked against
+    the compile probe (``TFS_ANALYZE_XCHECK=1``)."""
+    return envutil.env_raw(ENV_XCHECK).lower() in _TRUTHY
+
+
+@dataclasses.dataclass(frozen=True)
+class Classification:
+    """One program's size-generic row-dependence classification.
+
+    ``outputs``: per-output verdict; ``verdict``: the program-level meet
+    the dispatch gates consume; ``reason``: the first decisive evidence
+    (human-facing, stable enough for ``tfs.check`` advice strings)."""
+
+    verdict: str
+    outputs: Dict[str, str]
+    reason: str
+    probes: Tuple[int, ...] = _ANALYZE_PROBES
+
+    @property
+    def independent(self) -> bool:
+        return self.verdict == ROW_INDEPENDENT
+
+
+def _cell_sig(input_specs: Mapping[str, Any]) -> Tuple:
+    return tuple(
+        sorted(
+            (n, tuple(s.shape[1:]), str(s.dtype))
+            for n, s in input_specs.items()
+        )
+    )
+
+
+def input_specs_for(
+    program, columns: Mapping[str, Any]
+) -> Optional[Dict[str, jax.ShapeDtypeStruct]]:
+    """The one shared builder of the probe/classifier input-spec dict
+    the five row-independence gates used to hand-roll: program input
+    name -> ``ShapeDtypeStruct((2,) + cell, dtype)``.
+
+    ``columns`` maps each program input name to its schema
+    ``ColumnInfo``, an ``(array_like, dtype)`` pair (the pipeline's
+    layout form), or an existing ``ShapeDtypeStruct``.  Returns ``None``
+    when any input has no entry, a non-device scalar type, or a cell
+    shape that is not statically known (ragged / un-analyzed) — the
+    callers' "cannot even pose the proof" early-out."""
+    specs: Dict[str, jax.ShapeDtypeStruct] = {}
+    for name in program.input_names:
+        src = columns.get(name)
+        if src is None:
+            return None
+        if isinstance(src, jax.ShapeDtypeStruct):
+            cell = tuple(src.shape[1:])
+            np_dtype = src.dtype
+        elif hasattr(src, "cell_shape"):  # schema.ColumnInfo
+            if not src.scalar_type.device_ok:
+                return None
+            cell = tuple(src.cell_shape)
+            np_dtype = dtypes.coerce(src.scalar_type).np_dtype
+        else:  # (array_like, dtype) layout pair
+            data, dt = src
+            cell = tuple(np.shape(data))[1:]
+            np_dtype = np.dtype(dt)
+        if any(d is None or d < 0 for d in cell):
+            return None
+        specs[name] = jax.ShapeDtypeStruct((2,) + cell, np_dtype)
+    return specs
+
+
+def classify(program, input_specs: Mapping[str, Any]) -> Classification:
+    """Classify ``program``'s outputs once per (program, cell
+    signature); memoized on ``program._derived`` so every later
+    row-independence question — at ANY size set — is a dict lookup.
+
+    ``input_specs``: program input name -> ShapeDtypeStruct whose lead
+    dim is a placeholder (the classifier re-poses the cell shapes at its
+    own canonical probe sizes)."""
+    key = ("analysis", _cell_sig(input_specs))
+    cache = program._derived
+    if key not in cache:
+        cache[key] = _classify(program, input_specs)
+    return cache[key]
+
+
+def _classify(program, input_specs) -> Classification:
+    sizes = _ANALYZE_PROBES
+    names = sorted(input_specs)
+    cells = {
+        nm: (tuple(s.shape[1:]), s.dtype) for nm, s in input_specs.items()
+    }
+    try:
+        param_specs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                jnp.shape(a), jnp.asarray(a).dtype
+            ),
+            program.params,
+        )
+        traces = []
+        for n in sizes:
+            specs = {
+                nm: jax.ShapeDtypeStruct((n,) + cell, dt)
+                for nm, (cell, dt) in cells.items()
+            }
+            traces.append(_trace(program, specs, param_specs))
+    except _Bail:
+        return _unknown({}, "jaxpr shape not analyzable (literal outputs "
+                            "or call-boundary literals)")
+    except Exception as e:  # noqa: BLE001 — tracing user code
+        envutil.warn_once(
+            logger,
+            f"analysis:trace:{type(e).__name__}",
+            "analysis: classification trace failed (%s: %s); programs "
+            "of this shape fall back to the per-size compile probe",
+            type(e).__name__,
+            e,
+        )
+        return _unknown({}, f"trace failed: {type(e).__name__}: {e}")
+    try:
+        return _interpret(program, traces, names, sizes)
+    except _Bail:
+        return _unknown({}, "jaxpr structure not analyzable")
+    except Exception as e:  # noqa: BLE001 — classify() must stay total:
+        # the five dispatch gates call it bare where the old probe gate
+        # swallowed everything; a latent lattice bug must degrade to the
+        # probe fallback, not crash a verb (or the OOM-split recovery)
+        envutil.warn_once(
+            logger,
+            f"analysis:interpret:{type(e).__name__}",
+            "analysis: lattice interpretation failed for program %r "
+            "(%s: %s); falling back to the per-size compile probe — "
+            "likely an analyzer bug, please report the jaxpr",
+            getattr(program, "name", "?"),
+            type(e).__name__,
+            e,
+        )
+        return _unknown({}, f"interpretation failed: {type(e).__name__}: {e}")
+
+
+def _unknown(outputs: Dict[str, str], reason: str) -> Classification:
+    return Classification(UNKNOWN, dict(outputs), reason)
+
+
+def _interpret(program, traces, names, sizes) -> Classification:
+    t0 = traces[0]
+    out_names = sorted(t0["out_shape"])
+    all_unknown = {nm: UNKNOWN for nm in out_names}
+
+    # ---- structural identity across the canonical probes -------------------
+    for t in traces[1:]:
+        if (
+            len(t["eqns"]) != len(t0["eqns"])
+            or t["outs"] != t0["outs"]
+            or len(t["consts"]) != len(t0["consts"])
+            or len(t["lits"]) != len(t0["lits"])
+        ):
+            return _unknown(
+                all_unknown,
+                "trace structure varies with the block size (python "
+                "control flow branches on the row count)",
+            )
+        for (i0, c0), (i, c) in zip(t0["consts"], t["consts"]):
+            if i0 != i or not np.array_equal(np.asarray(c0), np.asarray(c)):
+                return _unknown(
+                    all_unknown, "captured constants vary with the block size"
+                )
+
+    # ---- literal classification --------------------------------------------
+    # slot -> "const" | "size" | None (unresolved)
+    lit_label: List[Optional[str]] = []
+    problems: List[Tuple[str, str]] = []  # ("size"|"cross"|"unknown", why)
+    for slot in range(len(t0["lits"])):
+        vals = [np.asarray(t["lits"][slot]) for t in traces]
+        v0 = vals[0]
+        if all(
+            v.shape == v0.shape and np.array_equal(v0, v) for v in vals[1:]
+        ):
+            lit_label.append("const")
+        elif all(v.ndim == 0 for v in vals) and _fit_family(
+            [v[()] for v in vals], sizes
+        ):
+            # strictly monotone count family (k*n, k/n, k*(n-1), k/(n-1)):
+            # no two distinct sizes coincide, so the probe rejects at any
+            # size set too — a definitive SIZE_DEPENDENT
+            lit_label.append("size")
+            problems.append(
+                ("size", "a literal tracks the block row count (count "
+                         "family, e.g. mean's /n)")
+            )
+        else:
+            lit_label.append(None)
+            problems.append(
+                ("unknown", "a literal varies with the block size outside "
+                            "the monotone count families")
+            )
+
+    # ---- per-var shape class (row vs group), across all probes -------------
+    all_shapes = [t["shapes"] for t in traces]
+
+    def var_class(i: int) -> Optional[str]:
+        ss = [sh[i] for sh in all_shapes]
+        if not all(len(s) == len(ss[0]) for s in ss[1:]):
+            return None
+        n_dims = []
+        for d in range(len(ss[0])):
+            dims = tuple(s[d] for s in ss)
+            if all(x == dims[0] for x in dims[1:]):
+                continue
+            if dims == sizes:
+                n_dims.append(d)
+            else:
+                return None  # non-monotone / non-lead size tracking
+        if not n_dims:
+            return "group"
+        if n_dims == [0]:
+            return "row"
+        return None
+
+    # ---- label propagation --------------------------------------------------
+    labels: Dict[int, Optional[str]] = {}
+    kw_leaf_count = len(names)
+    for i in range(t0["n_invars"]):
+        labels[i] = "row" if i < kw_leaf_count else "const"
+    for i, _c in t0["consts"]:
+        labels[i] = "const"
+        if var_class(i) != "group":
+            problems.append(
+                ("unknown", "a captured constant carries a row-sized axis")
+            )
+            labels[i] = None
+
+    def join(ls: Sequence[Optional[str]]) -> Optional[str]:
+        out = "const"
+        for l in ls:
+            if l is None:
+                return None
+            if _RANK[l] > _RANK[out]:
+                out = l
+        return out
+
+    for ei, e0 in enumerate(t0["eqns"]):
+        ealigned = [t["eqns"][ei] for t in traces]
+        name = e0.prim.name
+        if any(
+            e.prim.name != name
+            or e.invals != e0.invals
+            or e.outvars != e0.outvars
+            for e in ealigned[1:]
+        ):
+            return _unknown(
+                all_unknown,
+                "trace structure varies with the block size (python "
+                "control flow branches on the row count)",
+            )
+        keys = sorted(e0.params)
+        if any(sorted(e.params) != keys for e in ealigned[1:]):
+            return _unknown(all_unknown, "equation parameters vary in kind "
+                                         "with the block size")
+        tracks = False
+        unresolved_param = False
+        for k in keys:
+            vals = [e.params[k] for e in ealigned]
+            try:
+                _t, tk = _match_param(vals, sizes)
+            except _Bail:
+                if not all(v is None for v in vals):
+                    unresolved_param = True
+                tk = False
+            tracks = tracks or tk
+
+        in_labels = [
+            lit_label[iv[1]] if isinstance(iv, tuple) else labels.get(iv)
+            for iv in e0.invals
+        ]
+        lbl = join(in_labels)
+        whitelisted = (
+            name in _ELEMENTWISE or name in _SHAPEY or name in _REDUCE_KINDS
+        )
+        if unresolved_param:
+            problems.append(
+                ("unknown", f"{name}: a parameter varies with the block "
+                            f"size outside the monotone forms")
+            )
+            lbl = None
+        if lbl is not None:
+            if tracks and name not in _SHAPEY:
+                # an n-tracking VALUE parameter (e.g. integer_pow y=n):
+                # strictly monotone, so definitive at every size set
+                problems.append(
+                    ("size", f"{name}: a parameter tracks the block row "
+                             f"count")
+                )
+                lbl = "size" if _RANK[lbl] < _RANK["size"] else lbl
+            if not whitelisted:
+                # outside the probe's whitelist — the probe rejects this
+                # structurally at EVERY size set (whitelist membership
+                # does not depend on n), so a definitive negative
+                problems.append(
+                    ("cross", f"{name}: primitive outside the "
+                              f"row-independence whitelist")
+                )
+                lbl = "cross"
+            elif name in _REDUCE_KINDS and lbl == "row":
+                axes = e0.params.get("axes", ())
+                if 0 in axes:
+                    problems.append(
+                        ("cross", f"{name}: reduction over the block axis")
+                    )
+                    lbl = "cross"
+            elif name == "rev" and lbl == "row" and 0 in e0.params.get(
+                "dimensions", ()
+            ):
+                # row-axis reversal: row-shaped but position-dependent
+                # (the round-17 probe soundness fix, mirrored)
+                problems.append(
+                    ("cross", "rev: reversal along the block axis")
+                )
+                lbl = "cross"
+        out_classes = [var_class(ov) for ov in e0.outvars]
+        for ov, oc in zip(e0.outvars, out_classes):
+            vlbl = lbl
+            if vlbl is not None and oc is None:
+                problems.append(
+                    ("unknown", f"{name}: output shape class unresolved")
+                )
+                vlbl = None
+            elif vlbl == "row" and oc != "row":
+                # a row value whose output lost the row axis (the probe's
+                # out-class check rejects this at every size set)
+                problems.append(
+                    ("cross", f"{name}: row operand, non-row output")
+                )
+                vlbl = "cross"
+            elif vlbl == "const" and oc == "row":
+                # a group-side value broadcast onto the row axis (e.g.
+                # zeros_like): every row equal, but structurally outside
+                # the probe's acceptance — definitive, the broadcast
+                # shape tracks n monotonically at every size set
+                problems.append(
+                    ("cross", f"{name}: group value broadcast onto the "
+                              f"row axis")
+                )
+                vlbl = "cross"
+            labels[ov] = vlbl
+
+    # ---- per-output verdicts -----------------------------------------------
+    out_ids = t0["outs"]
+    outputs: Dict[str, str] = {}
+    for nm, ov in zip(out_names, out_ids):
+        lbl = labels.get(ov)
+        cls = var_class(ov)
+        if lbl is None or cls is None:
+            outputs[nm] = UNKNOWN
+        elif lbl == "cross":
+            outputs[nm] = CROSS_ROW
+        elif lbl == "size":
+            outputs[nm] = SIZE_DEPENDENT
+        elif lbl == "row" and cls == "row":
+            outputs[nm] = ROW_INDEPENDENT
+        else:  # const output (no row axis): not row-preserving
+            outputs[nm] = CROSS_ROW
+
+    # ---- program verdict (the probe's global strictness) -------------------
+    cross = next((why for kind, why in problems if kind == "cross"), None)
+    size = next((why for kind, why in problems if kind == "size"), None)
+    unknown = next(
+        (why for kind, why in problems if kind == "unknown"), None
+    )
+    if cross is None and any(v == CROSS_ROW for v in outputs.values()):
+        cross = "output is not row-preserving"
+    if size is None and any(
+        v == SIZE_DEPENDENT for v in outputs.values()
+    ):
+        size = "output value depends on the block size"
+    if cross is not None:
+        return Classification(CROSS_ROW, outputs, cross)
+    if size is not None:
+        return Classification(SIZE_DEPENDENT, outputs, size)
+    if unknown is not None or any(
+        v != ROW_INDEPENDENT for v in outputs.values()
+    ):
+        return Classification(
+            UNKNOWN, outputs, unknown or "unresolved output class"
+        )
+    return Classification(
+        ROW_INDEPENDENT, outputs,
+        "every equation row-preserving at every canonical probe",
+    )
+
+
+def rows_independent(
+    program, input_specs: Mapping[str, Any], sizes: Sequence[int]
+) -> bool:
+    """The shared row-independence gate: answer from the memoized static
+    classification when it is decisive (zero traces after the one-time
+    classification), fall back to the exact-size compile probe
+    (``segment_compile.cached_rows_independent``) on ``UNKNOWN`` — and,
+    under ``TFS_ANALYZE_XCHECK=1``, run BOTH and raise
+    :class:`AnalysisXCheckError` on an unsound disagreement."""
+    if not enabled():
+        return segment_compile.cached_rows_independent(
+            program, input_specs, sizes
+        )
+    cls = classify(program, input_specs)
+    if cls.verdict == UNKNOWN:
+        observability.note_analysis_probe_fallback()
+        return segment_compile.cached_rows_independent(
+            program, input_specs, sizes
+        )
+    observability.note_analysis_static_hit()
+    answer = cls.verdict == ROW_INDEPENDENT
+    if xcheck_enabled():
+        probed = segment_compile.cached_rows_independent(
+            program, input_specs, sizes
+        )
+        if answer and not probed:
+            raise AnalysisXCheckError(
+                f"analysis xcheck: classifier says ROW_INDEPENDENT but "
+                f"the compile probe disproves it at sizes "
+                f"{tuple(sizes)} (outputs {cls.outputs}; reason: "
+                f"{cls.reason}) — file the program's jaxpr"
+            )
+        if probed and not answer:
+            # conservative-direction disagreement: sound (the slow path
+            # runs), but worth one log line — it means a fast path the
+            # probe would grant is being left on the table
+            envutil.warn_once(
+                logger,
+                f"analysis:conservative:{cls.verdict}:{cls.reason}",
+                "analysis xcheck: classifier verdict %s (%s) where the "
+                "probe proves independence at %s; the exact path still "
+                "runs, but the classification is over-conservative",
+                cls.verdict,
+                cls.reason,
+                tuple(sizes),
+            )
+    return answer

@@ -91,7 +91,7 @@ def _read_grace_s(remaining_s: float) -> float:
 # duplicate admission slot (it waits for the original's outcome).
 _SAFE_METHODS = frozenset(
     {"ping", "schema", "health", "hello", "release", "metrics",
-     "attribution"}
+     "attribution", "check"}
 )
 
 
@@ -719,6 +719,37 @@ class RemoteFrame:
             "aggregate", graph, keys=list(keys), fetches=list(fetches),
             deadline_ms=deadline_ms,
         )
+
+    def check(
+        self,
+        verb: str,
+        graph: bytes,
+        fetches: Optional[Sequence[str]] = None,
+        inputs: Optional[Mapping[str, str]] = None,
+        shapes: Optional[Mapping[str, Sequence[int]]] = None,
+        keys: Optional[Sequence[str]] = None,
+        trim: bool = False,
+        deadline_ms: Optional[float] = None,
+    ) -> List[Dict[str, Any]]:
+        """Pre-dispatch contract verification (round 17): statically
+        validate ``graph`` against this frame for ``verb`` and return
+        the ``TFSxxx`` diagnostics — UNGATED server-side, so a tenant
+        can validate while the server is saturated, before burning an
+        admission slot (and a retry budget) on a request the verb would
+        refuse."""
+        r = self._c.call(
+            "check",
+            frame_id=self.frame_id,
+            verb=verb,
+            graph=graph,
+            fetches=list(fetches or []),
+            inputs=dict(inputs or {}),
+            shapes=dict(shapes or {}),
+            keys=list(keys or []),
+            trim=trim,
+            deadline_ms=deadline_ms,
+        )
+        return r["diagnostics"]
 
     def _row_verb(
         self, verb: str, graph: bytes, fetches, inputs=None, shapes=None,

@@ -25,9 +25,10 @@ staging, and dispatch.  This module is the throughput layer on top:
   REUSED, not forked — and outputs are sliced back per request.
   Per-request results are bit-identical to solo execution: ``map_rows``
   rows are independent by construction (vmap), and ``map_blocks``
-  coalescing is gated on the same jaxpr row-independence proof
-  bucketing uses (``segment_compile.cached_rows_independent``) at the
-  exact solo + coalesced sizes — a cross-row program never coalesces.
+  coalescing is gated on the same row-independence gate bucketing uses
+  (``analysis.rows_independent``: static classification first,
+  exact-size probe on ``UNKNOWN``) — a cross-row program never
+  coalesces.
   Attribution stays exact: the shared dispatch runs under a private
   batch ledger whose counters are apportioned to the participants by
   row share (largest-remainder, so the shares SUM to the batch's global
@@ -83,10 +84,11 @@ from .. import cancellation, observability
 from ..builder import compile_program
 from ..envutil import env_float as _env_float, env_int as _env_int
 from ..frame import TensorFrame
+from ..analysis import rowdep as analysis
 from ..ops import bucketing, device_pool
 from ..ops import engine as engine_mod
-from ..ops import segment_compile, validation
-from .. import dtypes
+from ..ops import validation
+from .. import envutil
 
 logger = logging.getLogger("tensorframes_tpu.bridge.coalescer")
 
@@ -147,11 +149,9 @@ class WarmSpec:
 
     @classmethod
     def from_env(cls, raw: Optional[str] = None) -> "WarmSpec":
-        import os
-
         if raw is None:
-            raw = os.environ.get(ENV_WARM, "")
-        raw = (raw or "").strip()
+            raw = envutil.env_raw(ENV_WARM)  # never None, already stripped
+        raw = raw.strip()
         if not raw:
             return cls()
         try:
@@ -524,16 +524,12 @@ class Coalescer:
                 sizes.update(
                     bucketing.bucket_for(s) for s in list(sizes)
                 )
-            specs = {
-                n: jax.ShapeDtypeStruct(
-                    (2,) + tuple(infos[n].cell_shape),
-                    dtypes.coerce(infos[n].scalar_type).np_dtype,
-                )
-                for n in program.input_names
-            }
-            ok = segment_compile.cached_rows_independent(
+            specs = analysis.input_specs_for(program, infos)
+            ok = specs is not None and analysis.rows_independent(
                 program, specs, sorted(s for s in sizes if s > 0)
             )
+        except analysis.AnalysisXCheckError:
+            raise  # the differential fence must fail loudly
         except Exception:  # noqa: BLE001 — unprovable = not coalescable
             ok = False
         ent.coalesce_ok = ok

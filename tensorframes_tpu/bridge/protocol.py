@@ -130,12 +130,12 @@ PROTOCOL_VERSION = 2
 # import, or :func:`configure_limits` at runtime — raise them deliberately
 # alongside allow_remote's trust statement if a deployment really collects
 # multi-GB frames through the bridge.
-import os as _os
+from .. import envutil as _envutil
 
 
 def _env_bytes(name: str, default: int) -> int:
-    raw = _os.environ.get(name)
-    if raw is None:
+    raw = _envutil.env_raw(name)
+    if not raw:
         return default
     try:
         return int(raw)

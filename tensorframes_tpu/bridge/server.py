@@ -128,10 +128,13 @@ _GATED_METHODS = frozenset(
 # _Session can never silently become a remotely callable method (or
 # bypass the admission gate under its raw name, as run_df_verb would).
 # CONTRACT: ungated methods skip the idempotency dedup, so each must be
-# NATURALLY idempotent (release is a pop that ignores unknown ids) —
+# NATURALLY idempotent (release is a pop that ignores unknown ids;
+# check is pure — static analysis, nothing compiled or dispatched) —
 # an ungated method with one-shot side effects would double-execute on
-# a client retry
-_UNGATED_METHODS = frozenset({"ping", "schema", "release"})
+# a client retry.  ``check`` (round 17) is DELIBERATELY ungated: its
+# whole point is that a tenant validates a program BEFORE burning an
+# admission slot on a request the verb would refuse.
+_UNGATED_METHODS = frozenset({"ping", "schema", "release", "check"})
 
 # how long a retried request waits for its still-running original
 # execution's outcome before giving up with ``retry_conflict``
@@ -537,6 +540,50 @@ class _Session:
     def release(self, frame_id: int):
         self.frames.pop(frame_id, None)
         return {}
+
+    def check(
+        self,
+        frame_id: int,
+        verb: str,
+        graph=None,
+        fetches=None,
+        inputs=None,
+        shapes=None,
+        keys=None,
+        trim: bool = False,
+    ):
+        """Pre-dispatch contract verification (``tfs.check``, round 17):
+        validate a program against a registered frame WITHOUT paying
+        admission, idempotency, or compile costs — returns the
+        structured ``TFSxxx`` diagnostics instead of the late refusal
+        the matching verb request would earn.
+
+        Deliberately ungated, with a known tradeoff: unlike the other
+        ungated methods (all O(1)), a check runs abstract traces
+        (``program.analyze`` eval_shape + the classifier's canonical
+        probes) on the server thread, outside admission/deadline/
+        fair-share scope and unmemoized across RPCs (each call builds a
+        fresh Program, so ``_derived`` never hits).  That is the point —
+        tenants must be able to validate BEFORE burning admission
+        budget — but it means a tenant looping ``check()`` with large
+        graphs consumes server CPU the shed machinery cannot see.
+        Acceptable while traces are ms-scale; if it bites, the fix is a
+        server-side (graph fingerprint, schema) -> diagnostics LRU, not
+        gating."""
+        frame = self.frame(frame_id)
+        from .. import analysis
+
+        v = "map_blocks_trimmed" if (verb == "map_blocks" and trim) else verb
+        diags = analysis.check(
+            frame,
+            graph,
+            v,
+            fetches=list(fetches) if fetches else None,
+            inputs=dict(inputs) if inputs else None,
+            shapes=dict(shapes) if shapes else None,
+            keys=list(keys) if keys else None,
+        )
+        return {"diagnostics": [d.as_dict() for d in diags]}
 
     def ping(self):
         return {"pong": True}

@@ -30,6 +30,7 @@ becomes O(1) across process restarts, not per run.
 from __future__ import annotations
 
 import os
+from . import envutil
 from typing import Optional
 
 ENV_VAR = "TFS_COMPILE_CACHE"
@@ -43,7 +44,7 @@ def configure(path: Optional[str] = None) -> bool:
 
     Safe to call repeatedly; re-pointing at a new path reconfigures."""
     global _configured_dir
-    path = path or os.environ.get(ENV_VAR) or None
+    path = path or envutil.env_raw(ENV_VAR) or None
     if not path:
         return _configured_dir is not None
     path = os.path.abspath(path)

@@ -47,6 +47,14 @@ Rules (each fires at most one diagnostic):
   shedding or queueing: the hog is starving the small tenants.  Set
   ``TFS_BRIDGE_FAIR_ROWS`` so the SLO scheduler enforces per-tenant
   budgets.
+* **indep_probe_churn** (round 17) — row-independence questions keep
+  falling back to the per-size compile probe instead of being answered
+  by the static classifier (``analysis/rowdep.py``): every new bucket
+  signature re-pays >= 2 probe traces the classifier exists to
+  eliminate.  Usually a program built from primitives outside the
+  classifier's whitelist — file the unclassified primitive so the
+  lattice learns it; ``TFS_ANALYZE_XCHECK=1`` plus the program's jaxpr
+  is the debugging evidence to attach.
 
 Every input is injectable (``counters=``, ``latency=``, ``ledger=``,
 ``spans=``, ``tenants=``) so tests and offline analysis run the same
@@ -389,6 +397,29 @@ def _rule_unfair_tenant(c, tenants) -> Optional[Dict[str, Any]]:
     )
 
 
+def _rule_indep_probe_churn(c) -> Optional[Dict[str, Any]]:
+    falls = c.get("analysis_probe_fallbacks", 0)
+    hits = c.get("analysis_static_hits", 0)
+    if falls < MIN_EVENTS or falls <= hits:
+        return None
+    return _diag(
+        "indep_probe_churn",
+        "info",
+        f"{falls} row-independence question(s) fell back to the "
+        f"per-size compile probe against {hits} static-classifier "
+        f"answer(s) — each fallback re-traces the program per new size "
+        f"set (>= 2 traces) where a classified program pays zero",
+        {"analysis_probe_fallbacks": falls, "analysis_static_hits": hits},
+        "TFS_ANALYZE",
+        "the dominant programs are outside the static classifier's "
+        "envelope (unclassified primitive, size-branching python "
+        "control flow, non-monotone literals) — file the program's "
+        "jaxpr so the lattice learns the primitive; run with "
+        "TFS_ANALYZE_XCHECK=1 to capture classifier-vs-probe evidence, "
+        "and keep TFS_ANALYZE on (the probe fallback stays sound)",
+    )
+
+
 def doctor(
     counters: Optional[Mapping[str, Any]] = None,
     latency: Optional[Mapping[str, Mapping[str, Any]]] = None,
@@ -428,6 +459,7 @@ def doctor(
         lambda: _rule_retry_burn(c),
         lambda: _rule_unfair_tenant(c, tenants),
         lambda: _rule_coalesce_miss(c),
+        lambda: _rule_indep_probe_churn(c),
         lambda: _rule_slow_tail(lat),
     ):
         d = rule()

@@ -10,6 +10,16 @@ import os
 from typing import Optional
 
 
+def env_raw(name: str, default: str = "") -> str:
+    """The raw (stripped) value of env knob ``name``; ``default`` when
+    unset.  The ONE place a ``TFS_*`` knob touches ``os.environ``:
+    callers with bespoke grammars (``auto`` tokens, ladders, fault
+    plans) read through here and keep their parse local, so the repo
+    lint (``tools/tfs_lint.py`` rule ``env-routing``) can prove no knob
+    read bypasses the shared clamp-and-fallback conventions."""
+    return os.environ.get(name, default).strip()
+
+
 def env_int(name: str, default: int, floor: int = 0) -> int:
     """``int(os.environ[name])`` clamped to ``floor``; ``default`` when
     unset or malformed."""

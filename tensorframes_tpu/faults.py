@@ -72,12 +72,12 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import os
 import random
 import time
 from typing import List, Optional, Tuple
 
 from . import observability
+from . import envutil
 
 logger = logging.getLogger("tensorframes_tpu.faults")
 
@@ -248,7 +248,7 @@ def specs() -> List[FaultSpec]:
     """The parsed ``TFS_FAULT_INJECT`` plan (cached per env value; read
     per call so tests and bench legs can flip it mid-process)."""
     global _cache
-    raw = os.environ.get(ENV_VAR, "").strip()
+    raw = envutil.env_raw(ENV_VAR)
     if raw == _cache[0]:
         return _cache[1]
     parsed = []

@@ -218,7 +218,16 @@ def _eval_function(graph: GraphDef, fname: str, args, depth: int):
 
 
 class GraphImportError(ValueError):
-    """The GraphDef cannot be lowered (unknown op, bad fetch, cycle...)."""
+    """The GraphDef cannot be lowered (unknown op, bad fetch, cycle...).
+
+    ``code``: the stable ``TFSxxx`` diagnostic code (``docs/ANALYSIS.md``)
+    that ``tfs.check`` reports for the same failure pre-dispatch —
+    ``TFS121`` for decode-prelude contract violations, ``TFS123`` for
+    structural import errors (the default)."""
+
+    def __init__(self, message: str, code: str = "TFS123"):
+        super().__init__(message)
+        self.code = code
 
 
 def load_graphdef(source: Union[str, bytes, os.PathLike]) -> GraphDef:
@@ -349,6 +358,7 @@ def import_graphdef(
                 f"{n.op} node {n.name!r} decodes a computed value; only "
                 f"placeholder-fed bytes can be decoded (the decode runs as "
                 f"a host stage before the device program)"
+                , code="TFS121"
             )
         # attrs the PIL prelude cannot honour are rejected here, not
         # silently diverged from: TF's dtype attr rescales values
@@ -359,6 +369,7 @@ def import_graphdef(
                 f"{n.op} node {n.name!r} requests dtype enum "
                 f"{dt_av.value}; only uint8 decode is supported (pass an "
                 f"explicit host_stage fn for other output types)"
+                , code="TFS121"
             )
         ratio_av = n.attrs.get("ratio")
         if ratio_av is not None and ratio_av.kind == "i" and int(
@@ -368,6 +379,7 @@ def import_graphdef(
                 f"{n.op} node {n.name!r} requests decode ratio "
                 f"{int(ratio_av.value)}; downsampling decode is not "
                 f"supported (pass an explicit host_stage fn)"
+                , code="TFS121"
             )
         ch_av = n.attrs.get("channels")
         channels = int(ch_av.value) if ch_av and ch_av.kind == "i" else 0
@@ -378,6 +390,7 @@ def import_graphdef(
                 raise GraphImportError(
                     f"placeholder {src!r} feeds decode nodes with "
                     f"conflicting channels ({prev!r} vs {n.name!r})"
+                    , code="TFS121"
                 )
         decode_src[n.name] = src
         fn = decode_mod.pil_decoder(channels, n.op)
@@ -425,6 +438,7 @@ def import_graphdef(
                     f"silently receive pixels instead of the encoded "
                     f"bytes. Feed that consumer from its own placeholder, "
                     f"or decode explicitly via host_stage."
+                    , code="TFS121"
                 )
         for out, name, _ in fetch_list:
             if name in byte_chain:
@@ -437,6 +451,7 @@ def import_graphdef(
                     f"pixels, so the fetch would silently return pixels. "
                     f"Fetch the decode node instead, or feed the bytes "
                     f"through their own placeholder."
+                    , code="TFS121"
                 )
     feed = dict(inputs or {})
     for k in feed:

@@ -29,7 +29,12 @@ from .. import dtypes as dt
 
 
 class UnsupportedOpError(NotImplementedError):
-    """A GraphDef node's op has no JAX lowering registered."""
+    """A GraphDef node's op has no JAX lowering registered.
+
+    ``code``: the stable ``TFSxxx`` diagnostic code (``docs/ANALYSIS.md``)
+    ``tfs.check`` reports for the same failure pre-dispatch."""
+
+    code = "TFS120"
 
 
 def _attr(attrs, name, default=None):

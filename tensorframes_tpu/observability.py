@@ -77,6 +77,7 @@ import itertools
 import json
 import logging
 import os
+from . import envutil
 import threading
 import time
 import uuid
@@ -156,6 +157,12 @@ _counters: Dict[str, int] = {
     "warm_program_misses": 0,
     "fair_share_sheds": 0,
     "slo_sheds": 0,
+    # static program analysis (round 17, tensorframes_tpu/analysis/):
+    # row-independence questions answered from the one-time jaxpr
+    # classification vs. those that fell back to the per-size compile
+    # probe — the ratio tfs.doctor()'s ``indep_probe_churn`` rule reads
+    "analysis_static_hits": 0,
+    "analysis_probe_fallbacks": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
 
@@ -695,6 +702,19 @@ def note_plan_cache_insert() -> None:
     _bump("plan_cache_inserts")
 
 
+def note_analysis_static_hit() -> None:
+    """One row-independence question answered by the static classifier
+    (``analysis/rowdep.py``) with NO per-size compile probe."""
+    _bump("analysis_static_hits")
+
+
+def note_analysis_probe_fallback() -> None:
+    """One row-independence question the classifier could not answer
+    (verdict UNKNOWN) that fell back to the per-size compile probe
+    (``segment_compile.cached_rows_independent``)."""
+    _bump("analysis_probe_fallbacks")
+
+
 def note_stream_window() -> None:
     """One streamed window materialised into host columns by the
     windowed reader (``streaming/reader.py``)."""
@@ -844,6 +864,8 @@ def counters_delta(
             "warm_program_misses",
             "fair_share_sheds",
             "slo_sheds",
+            "analysis_static_hits",
+            "analysis_probe_fallbacks",
         )
     }
 
@@ -887,7 +909,7 @@ def trace_enabled() -> bool:
     ov = _trace_state["override"]
     if ov is not None:
         return bool(ov)
-    return os.environ.get(ENV_TRACE, "").strip().lower() in _TRACE_TRUTHY
+    return envutil.env_raw(ENV_TRACE).lower() in _TRACE_TRUTHY
 
 
 def enable_trace(capacity: Optional[int] = None) -> None:

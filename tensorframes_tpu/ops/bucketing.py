@@ -27,10 +27,12 @@ change real rows' results —
 
 * ``map_rows``: rows are independent *by construction* (the cell program
   is vmapped over the lead axis), so map-rows blocks pad freely;
-* ``map_blocks``: gated on the jaxpr row-independence proof
-  (``segment_compile.cached_rows_independent``) verified at the exact
-  (real, padded) sizes — cross-row programs (block reductions, sorts,
-  block-size literals) keep their exact shapes;
+* ``map_blocks``: gated on the shared row-independence gate
+  (``analysis.rows_independent`` — the memoized size-generic
+  classification, with the exact-size probe as the ``UNKNOWN``
+  fallback; envelope caveats in ``analysis/rowdep.py``) — cross-row
+  programs (block reductions, sorts, block-size literals) keep their
+  exact shapes;
 * ragged ``map_rows`` cells: gated on the same proof applied along the
   ragged cell axis (``engine._map_rows_ragged``), with the uniform
   inputs bound as trace params (constant within a row, so the proof's
@@ -46,10 +48,10 @@ change real rows' results —
 from __future__ import annotations
 
 import logging
-import os
 from typing import Optional, Tuple
 
 import numpy as np
+from .. import envutil
 
 logger = logging.getLogger("tensorframes_tpu.bucketing")
 
@@ -87,7 +89,7 @@ def bucket_ladder() -> Optional[Tuple[int, ...]]:
     value that does not parse as a ladder of positive ints (and is not a
     disable token) logs a warning naming the value and falls back to
     the DEFAULT policy — the same behavior as not setting the knob."""
-    raw = os.environ.get(ENV_VAR, "").strip()
+    raw = envutil.env_raw(ENV_VAR)
     if not raw:
         return ()
     if raw.lower() in ("0", "off", "none", "false"):

@@ -62,7 +62,6 @@ the executable).  Map-terminal pipelines pool per block
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -70,6 +69,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import observability, resilience
+from .. import envutil
 from . import fault_tolerance, prefetch
 
 logger = logging.getLogger("tensorframes_tpu.device_pool")
@@ -109,7 +109,7 @@ def pool_devices() -> List[Any]:
     Read per call: the knob toggles mid-process (bench legs, tests)."""
     import jax
 
-    raw = os.environ.get(ENV_VAR, "auto").strip().lower()
+    raw = envutil.env_raw(ENV_VAR, "auto").lower()
     if raw in ("0", "1", "off", "none", "false"):
         return []
     if raw in ("", "auto", "all"):

@@ -37,14 +37,13 @@ from __future__ import annotations
 
 import functools
 import logging
-import os
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import cancellation, dtypes, faults, observability
+from .. import cancellation, dtypes, envutil, faults, observability
 from ..frame import Column, TensorFrame
 from ..program import Program
 from ..schema import ColumnInfo, Schema
@@ -58,6 +57,7 @@ from . import (
     segment_compile,
     validation,
 )
+from ..analysis import rowdep as analysis
 from .validation import ValidationError
 
 _log = logging.getLogger("tensorframes_tpu.engine")
@@ -290,11 +290,12 @@ class Executor:
     # row slices, each device_put + dispatched separately, so chunk k+1's
     # transfer overlaps chunk k's compute INSIDE the block instead of the
     # whole block's bytes landing before any compute starts.  Applied only
-    # to jaxpr-provably row-independent programs (segment_compile.
-    # rows_independent_at) — cross-row programs need the whole block.
+    # to row-independent programs per the shared gate (analysis.
+    # rows_independent: static classification first, exact-size probe on
+    # UNKNOWN) — cross-row programs need the whole block.
     # Tunable: TFS_STREAM_CHUNK_BYTES (0 disables).
-    stream_chunk_bytes = int(
-        os.environ.get("TFS_STREAM_CHUNK_BYTES", 64 * 1024 * 1024)
+    stream_chunk_bytes = envutil.env_int(
+        "TFS_STREAM_CHUNK_BYTES", 64 * 1024 * 1024
     )
 
     def _stream_plan(
@@ -313,7 +314,6 @@ class Executor:
             return None
         total = 0
         n_rows = None
-        specs = {}
         for name in program.input_names:
             value = block[program.column_for_input(name)]
             if isinstance(value, jax.Array):
@@ -326,9 +326,6 @@ class Executor:
             n_rows = arr.shape[0] if arr.ndim else None
             if n_rows is None:
                 return None
-            specs[name] = jax.ShapeDtypeStruct(
-                (2,) + arr.shape[1:], st.np_dtype
-            )
         if n_rows is None or total < 2 * chunk:
             return None
         n_chunks = -(-total // chunk)
@@ -336,11 +333,13 @@ class Executor:
         if per >= n_rows:
             return None
         if check_independence:
-            # verified at the EXACT executed sizes (semantic block size,
-            # chunk size, tail size) — sound against programs whose python
-            # control flow branches on the row count at any threshold
+            # statically classified once per program (analysis.rowdep);
+            # unclassifiable programs probe at the EXACT executed sizes
+            # (semantic block size, chunk size, tail size) — sound
+            # against python control flow branching at any threshold
+            specs = analysis.input_specs_for(program, infos)
             tail = n_rows % per or per
-            if not segment_compile.cached_rows_independent(
+            if specs is None or not analysis.rows_independent(
                 program, specs, (n_rows, per, tail)
             ):
                 return None
@@ -434,6 +433,11 @@ class Executor:
             # so a chunk OOM surfaces with its exact row range.
             outs = []
             for k, inputs in enumerate(pf):
+                # chunk boundary = cancellation checkpoint, same as the
+                # serial branch above (lint: checkpoint-coverage) — a
+                # deadline cuts the streamed dispatch between chunks
+                # instead of waiting out the whole block
+                cancellation.checkpoint()
                 lo = starts[k]
                 hi = min(starts[k] + per, n_rows)
                 holder = {"v": inputs}
@@ -504,11 +508,15 @@ class Executor:
 
         ``map_rows`` blocks pad freely — the cell program is vmapped over
         the row axis, so rows are independent by construction.
-        ``map_blocks`` padding is gated on the jaxpr row-independence
-        proof at the exact (real, padded) sizes
-        (``segment_compile.cached_rows_independent``), which rejects
-        cross-row programs, block-size literals, and size-branching
-        python control flow; those keep exact shapes and their per-size
+        ``map_blocks`` padding is gated on the shared row-independence
+        gate (``analysis.rows_independent``): the memoized size-generic
+        classification answers first, and the exact-size compile probe
+        (``segment_compile.cached_rows_independent``) runs on
+        ``UNKNOWN`` — together rejecting cross-row programs, block-size
+        literals, and size-branching python control flow (for classified
+        programs, up to the canonical-probe envelope documented in
+        ``analysis/rowdep.py``; ``TFS_ANALYZE_XCHECK=1`` is the fence).
+        Refused programs keep exact shapes and their per-size
         executables.  Out of scope, by design: trimmed maps (the output
         row count is program-defined, so sliced-back padding has no
         defined contract), host-staged ``map_blocks`` inputs (the staged
@@ -541,14 +549,8 @@ class Executor:
                 {sizes[bi] for bi, t in enumerate(targets) if t is not None}
                 | {t for t in targets if t is not None}
             )
-            specs = {
-                n: jax.ShapeDtypeStruct(
-                    (2,) + tuple(infos[n].cell_shape),
-                    dtypes.coerce(infos[n].scalar_type).np_dtype,
-                )
-                for n in program.input_names
-            }
-            if not segment_compile.cached_rows_independent(
+            specs = analysis.input_specs_for(program, infos)
+            if specs is None or not analysis.rows_independent(
                 program, specs, proof_sizes
             ):
                 return none_plan
@@ -993,14 +995,8 @@ class Executor:
                     if hi - lo >= 2 * floor:
                         mid = (lo + hi) // 2
                         stack += [(lo, mid), (mid, hi)]
-                specs = {
-                    n: jax.ShapeDtypeStruct(
-                        (2,) + tuple(infos[n].cell_shape),
-                        dtypes.coerce(infos[n].scalar_type).np_dtype,
-                    )
-                    for n in program.input_names
-                }
-                if not segment_compile.cached_rows_independent(
+                specs = analysis.input_specs_for(program, infos)
+                if specs is None or not analysis.rows_independent(
                     program, specs, sorted(sizes)
                 ):
                     refuse(
@@ -1479,7 +1475,9 @@ class Executor:
         lead (ragged) axis: jaxpr-proven elementwise along that axis, at
         the exact (real, bucketed) lengths.
 
-        The proof is :func:`segment_compile.rows_independent_at` posed on
+        The proof is the shared row-independence gate
+        (:func:`analysis.rows_independent` — static classification with
+        the exact-size compile probe as fallback) posed on
         the *cell* program with the ragged axis as the lead dim and every
         uniform input bound as a trace param — within one row the uniform
         inputs are constants w.r.t. the cell axis, which is exactly the
@@ -1517,7 +1515,9 @@ class Executor:
                     (2,) + rcells[0].shape[1:], st
                 )
             }
-            ok = segment_compile.rows_independent_at(probe, specs, sizes)
+            ok = analysis.rows_independent(probe, specs, sizes)
+        except analysis.AnalysisXCheckError:
+            raise  # the differential fence must fail loudly
         except Exception:
             ok = False
         cache[key] = ok

@@ -66,6 +66,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import observability
+from .. import envutil
 from ..envutil import parse_bytes, warn_once
 from . import device_pool
 
@@ -84,7 +85,7 @@ def hbm_budget() -> int:
     Accepts plain bytes or a ``K``/``M``/``G`` binary suffix
     (``envutil.parse_bytes``).  Read per call so tests and bench legs
     can flip it mid-process."""
-    raw = os.environ.get(ENV_BUDGET, "")
+    raw = envutil.env_raw(ENV_BUDGET)
     if not raw.strip():
         return 0
     parsed = parse_bytes(raw)
@@ -110,7 +111,7 @@ def shard_devices(explicit: Optional[bool] = None) -> List[Any]:
     ``1``/``always`` shards over all local devices even with the pool
     knob off; ``0``/``off`` never shards.  ``explicit=True``/``False``
     (the ``cache(sharded=)`` argument) overrides the env the same way."""
-    raw = os.environ.get(ENV_SHARDED, "auto").strip().lower()
+    raw = envutil.env_raw(ENV_SHARDED, "auto").lower()
     if explicit is None:
         if raw in ("0", "off", "false", "no", "none"):
             return []

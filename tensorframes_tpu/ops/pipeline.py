@@ -65,13 +65,13 @@ from ..frame import TensorFrame, is_device_array
 from ..program import Program
 from ..schema import ColumnInfo, Schema
 from ..shape import Shape, UNKNOWN
+from ..analysis import rowdep as analysis
 from . import (
     bucketing,
     device_pool,
     fault_tolerance,
     frame_cache,
     prefetch,
-    segment_compile,
     validation,
 )
 from .engine import _DEFAULT
@@ -789,16 +789,13 @@ class Pipeline:
                 lambda **cols: self._block_chain(cols, params_list),
                 sorted(layout),
             )
-            specs = {
-                n: jax.ShapeDtypeStruct(
-                    (2,) + tuple(np.shape(d)[1:]), np.dtype(dt)
-                )
-                for n, (d, dt) in layout.items()
-            }
+            specs = analysis.input_specs_for(probe, layout)
             try:
-                ok = segment_compile.rows_independent_at(
+                ok = specs is not None and analysis.rows_independent(
                     probe, specs, proof_sizes
                 )
+            except analysis.AnalysisXCheckError:
+                raise
             except Exception:
                 ok = False
             self._pool_proofs[key] = ok

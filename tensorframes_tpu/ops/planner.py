@@ -69,7 +69,6 @@ from __future__ import annotations
 
 import collections
 import logging
-import os
 import time
 import weakref
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Set, Tuple
@@ -77,19 +76,19 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from .. import cancellation, dtypes, observability
+from .. import envutil
 from .. import roofline as _roofline
 from ..frame import TensorFrame
 from ..program import Program
 from ..schema import ColumnInfo
-from ..shape import UNKNOWN
 from . import (
     bucketing,
     device_pool,
     fault_tolerance,
     frame_cache,
     prefetch,
-    segment_compile,
 )
+from ..analysis import rowdep as analysis
 from .engine import _DEFAULT, Executor, GroupedFrame, _check_shape_hints
 from .pipeline import analyzed_outputs
 from .validation import ValidationError
@@ -105,11 +104,11 @@ def planning_enabled() -> bool:
     """Whether ``TFS_PLAN`` routes the module-level verbs through the
     planner for plain frames (read per call: bench legs and tests flip
     it mid-process)."""
-    return os.environ.get(ENV_PLAN, "").strip().lower() in _TRUTHY
+    return envutil.env_raw(ENV_PLAN).lower() in _TRUTHY
 
 
 def pool_min_intensity() -> float:
-    raw = os.environ.get(ENV_POOL_INTENSITY, "").strip()
+    raw = envutil.env_raw(ENV_POOL_INTENSITY)
     if not raw:
         return 1.0
     try:
@@ -344,16 +343,9 @@ def _compose(steps: Sequence[PlanStep], frame: TensorFrame) -> _FusedMeta:
             step_infos[name] = infos_now[col]
         # (2, *cell) probe specs for the row-independence proof behind
         # bucket padding — None when a cell dim is Unknown at this stage
-        specs: Optional[Dict[str, Any]] = {}
-        for name, ci in step_infos.items():
-            cell = tuple(ci.cell_shape)
-            if any(d == UNKNOWN for d in cell):
-                specs = None
-                break
-            specs[name] = jax.ShapeDtypeStruct(
-                (2,) + cell, dtypes.coerce(ci.scalar_type).np_dtype
-            )
-        stage_specs.append(specs)
+        stage_specs.append(
+            analysis.input_specs_for(st.program, step_infos)
+        )
         outs = _analyzed_outputs_cached(
             st.program, step_infos, cell=st.kind == "map_rows"
         )
@@ -680,7 +672,7 @@ def _chain_pads(
     for st, specs in zip(meta.steps, meta.stage_specs):
         if st.kind == "map_rows":
             continue
-        if specs is None or not segment_compile.cached_rows_independent(
+        if specs is None or not analysis.rows_independent(
             st.program, specs, proof_sizes
         ):
             return none

@@ -65,7 +65,6 @@ carries over unchanged because only host-fresh frames ever pool.
 from __future__ import annotations
 
 import contextvars
-import os
 import queue
 import threading
 import time
@@ -75,6 +74,7 @@ import jax
 import numpy as np
 
 from .. import observability
+from .. import envutil
 
 DEFAULT_DEPTH = 2
 
@@ -98,7 +98,7 @@ _DONATING_BACKENDS = ("tpu", "gpu", "cuda", "rocm")
 
 def prefetch_depth() -> int:
     """The staging window depth from ``TFS_PREFETCH_BLOCKS`` (>=0)."""
-    raw = os.environ.get("TFS_PREFETCH_BLOCKS", "")
+    raw = envutil.env_raw("TFS_PREFETCH_BLOCKS")
     try:
         return max(0, int(raw))
     except ValueError:
@@ -120,7 +120,7 @@ def donate_inputs() -> bool:
     """Whether freshly staged input buffers should be donated to the
     consuming executable (``TFS_DONATE``; ``auto`` = backend supports
     donation)."""
-    raw = os.environ.get("TFS_DONATE", "auto").lower()
+    raw = envutil.env_raw("TFS_DONATE", "auto").lower()
     if raw in ("1", "true", "yes"):
         return True
     if raw in ("0", "false", "no"):

@@ -43,12 +43,17 @@ scatter-reduce form.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.extend.core  # noqa: F401 - jax.extend needs an explicit import
 import jax.numpy as jnp
 import numpy as np
+
+from .. import envutil
+
+logger = logging.getLogger(__name__)
 
 _Literal = jax.extend.core.Literal
 
@@ -529,6 +534,14 @@ def _recognize(program, input_specs, bases) -> Optional[SegmentPlan]:
                 if any(oc != "row" for oc in out_classes):
                     raise _Bail()
             elif name in _SHAPEY:
+                if name == "rev" and 0 in e2.params.get(
+                    "dimensions", ()
+                ):
+                    # a block-axis reversal in the ROW stage would
+                    # misalign rows with their per-row group ids before
+                    # the segment reduction (round-17 soundness fix,
+                    # same hole as rows_independent_at's)
+                    raise _Bail()
                 if any(oc != "row" for oc in out_classes):
                     raise _Bail()
             else:
@@ -721,9 +734,31 @@ def rows_independent_at(
             sizes = sizes + (2 if 2 not in sizes else 3,)
         return _row_independent(program, input_specs, sizes)
     except _Bail:
+        return False  # a structural mismatch IS the proof failing
+    except (TypeError, ValueError, ZeroDivisionError):
+        # the user program itself refused to trace at a probe size
+        # (shape-dependent python errors, concretization failures): a
+        # legitimate "not provable", same as a structural mismatch
         return False
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — anything else is OUR bug
+        # (or a jax regression), not evidence of cross-row semantics;
+        # silently answering False would mask it as "cross-row" forever
+        envutil.warn_once(
+            logger,
+            f"rowindep:{_program_name(program)}:{type(e).__name__}",
+            "rows_independent_at: probe failed unexpectedly for "
+            "program %s (%s: %s); treating as cross-row — file this, "
+            "the probe should either prove or _Bail",
+            _program_name(program),
+            type(e).__name__,
+            e,
+        )
         return False
+
+
+def _program_name(program) -> str:
+    fn = getattr(program, "_fn", None)
+    return getattr(fn, "__name__", None) or repr(fn)
 
 
 def cached_rows_independent(program, input_specs, sizes) -> bool:
@@ -805,6 +840,14 @@ def _row_independent(program, input_specs, sizes) -> bool:
             # whitelist
             if name in _REDUCE_KINDS:
                 if 0 in e0.params.get("axes", ()):
+                    return False
+            elif name == "rev":
+                # rev along the BLOCK axis permutes row positions while
+                # preserving the row-shaped class — the one _SHAPEY
+                # member whose row-axis form is order-sensitive (found
+                # by the round-17 analyzer differential; padding a
+                # row-reversal would land the pad rows at the front)
+                if 0 in e0.params.get("dimensions", ()):
                     return False
             elif name not in _ELEMENTWISE and name not in _SHAPEY:
                 return False

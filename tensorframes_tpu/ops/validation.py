@@ -35,7 +35,16 @@ from ..shape import Shape, UNKNOWN
 
 class ValidationError(ValueError):
     """A verb's schema contract was violated (reference: the require(...)
-    failures in SchemaTransforms)."""
+    failures in SchemaTransforms).
+
+    ``code``: the stable ``TFSxxx`` diagnostic code (round 17,
+    ``docs/ANALYSIS.md``) — the same taxonomy ``tfs.check`` reports
+    pre-dispatch, attached here so the dispatch-time failure and the
+    static diagnostic are the SAME error, not two prose variants."""
+
+    def __init__(self, message: str, code: str = None):
+        super().__init__(message)
+        self.code = code
 
 
 def _column_for_input(
@@ -53,7 +62,8 @@ def _column_for_input(
             f"{verb}: program input {input_name!r} requests column "
             f"{col_name!r}, which does not exist in the frame. Available "
             f"columns: {schema.names}. (Program inputs are matched to columns "
-            f"by name; pass feed_dict={{input: column}} to rename.)"
+            f"by name; pass feed_dict={{input: column}} to rename.)",
+            code="TFS103",
         )
     ci = schema[col_name]
     if host_staged:
@@ -68,7 +78,8 @@ def _column_for_input(
             f"directly. Pass host_stage={{{input_name!r}: decode_fn}} to run "
             f"a host-side preprocessing stage (e.g. JPEG decode -> uint8 "
             f"pixels) before the device program — the reference's in-graph "
-            f"DecodeJpeg contract (read_image.py:164-167)."
+            f"DecodeJpeg contract (read_image.py:164-167).",
+            code="TFS104",
         )
     if not ci.is_analyzed:
         if allow_ragged:
@@ -80,7 +91,8 @@ def _column_for_input(
             f"{verb}: column {col_name!r} has un-analyzed cell shape "
             f"{ci.cell_shape}. Run tensorframes_tpu.analyze(frame) first, "
             f"construct the frame from uniform arrays, or use map_rows "
-            f"(which buckets ragged rows by shape)."
+            f"(which buckets ragged rows by shape).",
+            code="TFS105",
         )
     return ci
 
@@ -101,7 +113,8 @@ def check_map_inputs(
     if unknown:
         raise ValidationError(
             f"{verb}: host_stage given for names {sorted(unknown)} that are "
-            f"not program inputs; inputs are {program.input_names}"
+            f"not program inputs; inputs are {program.input_names}",
+            code="TFS112",
         )
     out = {}
     for n in program.input_names:
@@ -131,14 +144,16 @@ def check_reduce_rows(program: Program, frame: TensorFrame) -> Dict[str, ColumnI
             raise ValidationError(
                 f"reduce_rows: program input {n!r} does not follow the "
                 f"pairwise naming convention: every input must be named "
-                f"'<col>_1' or '<col>_2' (Operations.scala:86-96)."
+                f"'<col>_1' or '<col>_2' (Operations.scala:86-96).",
+                code="TFS106",
             )
     for base, halves in suffixed.items():
         if halves != {"1", "2"}:
             raise ValidationError(
                 f"reduce_rows: column {base!r} must be consumed as BOTH "
                 f"{base}_1 and {base}_2; found only suffix(es) "
-                f"{sorted(halves)}."
+                f"{sorted(halves)}.",
+                code="TFS106",
             )
         # feed-dict rename (round 11): both halves of a pair must feed
         # from the SAME column (the pairwise fold has one source)
@@ -149,19 +164,22 @@ def check_reduce_rows(program: Program, frame: TensorFrame) -> Dict[str, ColumnI
         if col != col2:
             raise ValidationError(
                 f"reduce_rows: inputs {base}_1/{base}_2 must feed from one "
-                f"column; the feed maps them to {col!r} and {col2!r}."
+                f"column; the feed maps them to {col!r} and {col2!r}.",
+                code="TFS107",
             )
         schema = frame.schema
         if col not in schema:
             raise ValidationError(
                 f"reduce_rows: inputs {base}_1/{base}_2 refer to column "
-                f"{col!r}, which does not exist. Available: {schema.names}."
+                f"{col!r}, which does not exist. Available: {schema.names}.",
+                code="TFS103",
             )
         ci = schema[col]
         if not ci.is_analyzed:
             raise ValidationError(
                 f"reduce_rows: column {col!r} has un-analyzed cell shape "
-                f"{ci.cell_shape}; run analyze(frame) first."
+                f"{ci.cell_shape}; run analyze(frame) first.",
+                code="TFS105",
             )
         outputs[base] = ci
     return outputs
@@ -177,7 +195,8 @@ def check_reduce_rows_outputs(
         raise ValidationError(
             f"reduce_rows: program outputs {sorted(out_names)} must exactly "
             f"match the reduced columns {sorted(expected)} (each output x is "
-            f"the combined value of x_1 and x_2)."
+            f"the combined value of x_1 and x_2).",
+            code="TFS109",
         )
     for s in summaries:
         if s.is_output:
@@ -186,7 +205,8 @@ def check_reduce_rows_outputs(
                 raise ValidationError(
                     f"reduce_rows: output {s.name!r} has shape {s.shape} but "
                     f"column {s.name!r} has cell shape {ci.cell_shape}; a "
-                    f"pairwise reducer must preserve the cell shape."
+                    f"pairwise reducer must preserve the cell shape.",
+                    code="TFS109",
                 )
 
 
@@ -204,7 +224,8 @@ def check_reduce_blocks(
                 f"{verb}: program input {n!r} does not follow the block "
                 f"naming convention: every input must be named '<col>_input' "
                 f"and consume a whole block of column <col> "
-                f"(Operations.scala:98-108)."
+                f"(Operations.scala:98-108).",
+                code="TFS108",
             )
         base = n[: -len("_input")]
         # feed-dict rename (round 11): ``inputs={"x_input": "data"}``
@@ -219,18 +240,21 @@ def check_reduce_blocks(
         if col not in schema:
             raise ValidationError(
                 f"{verb}: input {n!r} refers to column {col!r}, which does "
-                f"not exist. Available: {schema.names}."
+                f"not exist. Available: {schema.names}.",
+                code="TFS103",
             )
         ci = schema[col]
         if not ci.is_analyzed:
             raise ValidationError(
                 f"{verb}: column {col!r} has un-analyzed cell shape "
-                f"{ci.cell_shape}; run analyze(frame) first."
+                f"{ci.cell_shape}; run analyze(frame) first.",
+                code="TFS105",
             )
         if not ci.scalar_type.device_ok:
             raise ValidationError(
                 f"{verb}: column {col!r} is host-only ({ci.scalar_type}) and "
-                f"cannot be reduced on device."
+                f"cannot be reduced on device.",
+                code="TFS104",
             )
         outputs[base] = ci
     return outputs
@@ -247,7 +271,8 @@ def check_reduce_blocks_outputs(
         raise ValidationError(
             f"{verb}: program outputs {sorted(out_names)} must exactly match "
             f"the reduced columns {sorted(expected)} (each output x is the "
-            f"block-reduction of x_input)."
+            f"block-reduction of x_input).",
+            code="TFS109",
         )
     for s in summaries:
         if s.is_output:
@@ -257,5 +282,6 @@ def check_reduce_blocks_outputs(
                     f"{verb}: output {s.name!r} has shape {s.shape} but column "
                     f"{s.name!r} has cell shape {ci.cell_shape}; a block "
                     f"reducer must emit one cell per block so the reduction "
-                    f"can be re-applied across blocks."
+                    f"can be re-applied across blocks.",
+                    code="TFS109",
                 )

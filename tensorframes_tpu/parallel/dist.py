@@ -39,9 +39,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .. import dtypes
+from ..analysis import rowdep as analysis
 from ..frame import TensorFrame
-from ..ops import segment_compile, validation
+from ..ops import validation
 from ..ops.engine import Executor, _check_shape_hints, _np, _with_prelude
 from ..ops.validation import ValidationError
 from ..program import Program
@@ -189,30 +189,27 @@ class MeshExecutor(Executor):
 
     def _pad_safe(self, program, frame, infos, host_stage) -> bool:
         """Whether ``map_blocks`` may pad+mask this program to the mesh
-        size: jaxpr-proven row independence (``segment_compile.
-        cached_rows_independent``), memoized on the Program per input
-        signature.  Host-staged inputs skip the fast path (their cell
-        shapes are only known after staging)."""
+        size: jaxpr-proven row independence (``analysis.rows_independent``
+        — static classification, per-size probe fallback), memoized on
+        the Program per input signature.  Host-staged inputs skip the
+        fast path (their cell shapes are only known after staging)."""
         if host_stage:
             return False
-        specs = {}
         for name in program.input_names:
             col = frame.column(program.column_for_input(name))
-            st = col.info.scalar_type
-            if col.is_ragged or not st.device_ok:
+            if col.is_ragged or not col.info.scalar_type.device_ok:
                 return False
-            cell = tuple(np.shape(col.data))[1:]  # concrete cell shape
-            specs[name] = jax.ShapeDtypeStruct(
-                (2,) + cell, dtypes.coerce(st).np_dtype
-            )
-        # verified at the EXACT sizes involved: the true row count (the
-        # semantics) and the padded count (what executes) — sound against
-        # python control flow branching on the row count at any threshold
+        specs = analysis.input_specs_for(program, infos)
+        if specs is None:
+            return False
+        # statically classified once per program (analysis.rowdep);
+        # unclassifiable programs probe at the EXACT sizes involved: the
+        # true row count (the semantics) and the padded count (what
+        # executes) — sound against python control flow branching on the
+        # row count at any threshold
         n = frame.num_rows
         padded = n + ((-n) % self._num_shards)
-        return segment_compile.cached_rows_independent(
-            program, specs, (n, padded)
-        )
+        return analysis.rows_independent(program, specs, (n, padded))
 
     def _finish_map(
         self, frame: TensorFrame, outs: Dict[str, jnp.ndarray], trim: bool

@@ -64,6 +64,7 @@ from typing import Any, Callable, Iterator, List, Optional, Sequence
 import numpy as np
 
 from .. import observability
+from .. import envutil
 from ..envutil import env_bytes, env_int, warn_once
 from ..frame import TensorFrame
 from ..ops import prefetch
@@ -169,7 +170,7 @@ def clamped_window(requested: int, schema, label: str = "stream") -> int:
                 "%d concurrent windows; clamping the %d-row window "
                 "to %d",
                 ENV_HOST_BUDGET,
-                os.environ.get(ENV_HOST_BUDGET, ""),
+                envutil.env_raw(ENV_HOST_BUDGET),
                 fit,
                 concurrent,
                 w,

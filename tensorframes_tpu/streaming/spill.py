@@ -35,6 +35,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from .. import observability
+from .. import envutil
 
 logger = logging.getLogger("tensorframes_tpu.streaming")
 
@@ -43,7 +44,7 @@ ENV_SPILL_DIR = "TFS_SPILL_DIR"
 
 def spill_dir() -> str:
     """The configured spill root (``TFS_SPILL_DIR``; "" = disabled)."""
-    return os.environ.get(ENV_SPILL_DIR, "").strip()
+    return envutil.env_raw(ENV_SPILL_DIR)
 
 
 def configured() -> bool:

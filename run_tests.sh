@@ -105,6 +105,22 @@ JAX_PLATFORMS=cpu \
   python -m pytest tests/test_stream_frames.py -q
 rm -rf "$TFS_SPILL_TMP"
 
+# Relational tier: the round-18 shuffle / windowed-join / bridge-
+# pipeline tests re-run with the TFS_SHUFFLE_*/TFS_JOIN_* knobs LIVE
+# and a tmpdir spill root — the main suite runs the same file with
+# conftest pinning the knobs inert (tests pass explicit spill stores);
+# this tier proves the env wiring end to end: env-partitioned shuffle
+# runs, auto strategy choice under a small broadcast threshold (the
+# sort-merge leg engages), and host-budget-bounded re-keying.
+echo "== relational tier (shuffle + joins + pipelines, env knobs live) =="
+TFS_REL_TMP="$(mktemp -d)"
+TFS_SPILL_DIR="$TFS_REL_TMP" TFS_SHUFFLE_PARTITIONS=4 \
+TFS_JOIN_BROADCAST_BYTES=1M TFS_STREAM_WINDOW=256 TFS_HOST_BUDGET=1M \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_relational.py -q
+rm -rf "$TFS_REL_TMP"
+
 # Observability tier: the flight-recorder / histogram / metrics tests
 # re-run with TFS_TRACE=1 LIVE (the main suite pins it off and tests
 # drive the recorder via observability.enable_trace(); this tier proves

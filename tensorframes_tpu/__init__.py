@@ -52,6 +52,8 @@ from .schema import ColumnInfo, Schema, SchemaError
 from .shape import Shape, ShapeError, UNKNOWN
 from . import streaming
 from .streaming import scan_parquet
+from . import relational
+from .relational import join, join_frames, shuffle
 
 __version__ = "0.1.0"
 
@@ -113,6 +115,10 @@ __all__ = [
     "reduce_rows",
     "scan_parquet",
     "streaming",
+    "relational",
+    "join",
+    "join_frames",
+    "shuffle",
     "Program",
     "ProgramError",
     "GraphNodeSummary",

@@ -66,6 +66,7 @@ __all__ = [
     "rows_independent",
     "input_specs_for",
     "check",
+    "check_relational",
     "Diagnostic",
     "CODES",
 ]
@@ -79,6 +80,15 @@ def check(*args, **kwargs):
     from . import contracts
 
     return contracts.check(*args, **kwargs)
+
+
+def check_relational(*args, **kwargs):
+    """Relational (join/shuffle) contract verification — see
+    :func:`tensorframes_tpu.analysis.contracts.check_relational`.  Lazy
+    for the same ``ops`` import-order reason as :func:`check`."""
+    from . import contracts
+
+    return contracts.check_relational(*args, **kwargs)
 
 
 def __getattr__(name):

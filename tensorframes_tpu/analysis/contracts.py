@@ -33,6 +33,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Mapping, Optional, Sequence
 
+import numpy as np
+
 from . import rowdep
 
 # the stable diagnostic registry: code -> (title, default severity).
@@ -62,6 +64,12 @@ CODES: Dict[str, tuple] = {
     "TFS123": ("GraphDef structurally invalid", "error"),
     "TFS130": ("program is not row-independent", "info"),
     "TFS131": ("row-dependence unknown (dispatch will probe)", "info"),
+    # TFS14x: relational contracts (round 18, tensorframes_tpu/relational/)
+    "TFS140": ("shuffle/join key column missing or duplicated", "error"),
+    "TFS141": ("join key columns have mismatched dtypes", "error"),
+    "TFS142": ("shuffle/join key cells are ragged / non-hashable",
+               "error"),
+    "TFS143": ("join output column name collision", "error"),
 }
 
 _SEV_RANK = {"error": 0, "warn": 1, "info": 2}
@@ -103,6 +111,104 @@ def _from_exception(e: BaseException, default_code: str, location: str,
     return _diag(code, str(e), location, advice)
 
 
+def check_relational(
+    frame,
+    verb: str,
+    keys: Optional[Sequence[str]] = None,
+    right=None,
+    how: str = "inner",
+) -> List[Diagnostic]:
+    """Relational contract verification (round 18): the ``TFS14x``
+    checks for ``verb`` in ``shuffle``/``join`` — key presence,
+    duplication, scalar/hashable cells, cross-side dtype match, and
+    output-name collisions — statically, against the schemas alone.
+    Worst-first, like :func:`check`; the same codes ride the
+    dispatch-time ``ValidationError`` the verbs raise."""
+    diags: List[Diagnostic] = []
+    keys = list(keys or ())
+    loc = f"{verb}:key"
+    if not keys:
+        return [_diag(
+            "TFS140", f"{verb} needs a key column", loc,
+            "pass on=<column> (join) / key=<column> (shuffle)",
+        )]
+    if len(keys) > len(set(keys)):
+        diags.append(_diag(
+            "TFS140",
+            f"{verb}: key columns {keys} name a column more than once",
+            loc, "each key column may appear once",
+        ))
+    if len(set(keys)) > 1:
+        diags.append(_diag(
+            "TFS140",
+            f"{verb}: multi-column keys are not supported yet "
+            f"({keys}); combine the columns into one key first",
+            loc, "re-key on a single column",
+        ))
+    key = keys[0]
+
+    def _side(f, side: str):
+        schema = f.schema
+        if key not in schema:
+            diags.append(_diag(
+                "TFS140",
+                f"{verb}: key column {key!r} does not exist on the "
+                f"{side} side. Available columns: {schema.names}",
+                f"{loc}:{side}",
+                "the key must name an existing column on both sides",
+            ))
+            return None
+        ci = schema[key]
+        if ci.cell_shape.rank != 0:
+            diags.append(_diag(
+                "TFS142",
+                f"{verb}: {side} key column {key!r} holds cells of "
+                f"shape {ci.cell_shape}; keys must be scalar",
+                f"{loc}:{side}",
+                "hash-partitioning needs one hashable cell per row",
+            ))
+        col = f.column(key)
+        if col.is_ragged and not isinstance(col.data, np.ndarray):
+            diags.append(_diag(
+                "TFS142",
+                f"{verb}: {side} key column {key!r} holds ragged "
+                f"cells; analyze/bucket the frame first",
+                f"{loc}:{side}",
+                "ragged cells have no stable byte representation to "
+                "hash",
+            ))
+        return ci
+
+    lci = _side(frame, "left")
+    if verb == "join" and right is not None:
+        rci = _side(right, "right")
+        if lci is not None and rci is not None and (
+            lci.scalar_type.name != rci.scalar_type.name
+        ):
+            diags.append(_diag(
+                "TFS141",
+                f"join: key column {key!r} has dtype "
+                f"{lci.scalar_type.name} on the left and "
+                f"{rci.scalar_type.name} on the right",
+                loc,
+                "byte-equality joins need one representation; cast "
+                "one side first",
+            ))
+        collide = sorted(
+            (set(frame.column_names) & set(right.column_names)) - {key}
+        )
+        if collide:
+            diags.append(_diag(
+                "TFS143",
+                f"join: non-key column name(s) {collide} exist on "
+                f"both sides",
+                f"{verb}:columns",
+                "rename or drop one side's columns before joining",
+            ))
+    diags.sort(key=lambda d: (_SEV_RANK[d.severity], d.code))
+    return diags
+
+
 def check(
     frame,
     program,
@@ -113,6 +219,8 @@ def check(
     shapes: Optional[Mapping[str, Sequence[int]]] = None,
     outputs: Optional[Mapping[str, str]] = None,
     keys: Optional[Sequence[str]] = None,
+    right=None,
+    how: str = "inner",
 ) -> List[Diagnostic]:
     """Statically verify ``program`` against ``frame``'s schema for
     ``verb``; returns diagnostics sorted worst-first (empty = the
@@ -125,13 +233,18 @@ def check(
     Nothing is compiled and nothing dispatches: the only traces are
     ``eval_shape`` (no FLOPs) and the one-time row-dependence
     classification, both excluded from the retrace counters."""
+    if verb in ("join", "shuffle"):
+        # relational verbs carry no program: the TFS14x key contracts
+        # are the whole static surface (round 18)
+        return check_relational(frame, verb, keys, right=right, how=how)
     diags: List[Diagnostic] = []
     if verb not in _VERBS:
         return [_diag(
             "TFS101",
             f"unknown verb {verb!r}",
             "verb",
-            f"one of {', '.join(_VERBS)}",
+            f"one of {', '.join(_VERBS)} (or the relational verbs "
+            f"join/shuffle)",
         )]
 
     # ---- program construction (GraphDef import included) -------------------

@@ -632,6 +632,41 @@ class BridgeClient:
             rows=[int(r) for r in (rows or [])],
         )
 
+    def run_pipeline(
+        self,
+        source: Mapping[str, Any],
+        stages: Sequence[Mapping[str, Any]],
+        sink: Optional[Mapping[str, Any]] = None,
+        deadline_ms: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Execute a whole source -> map -> join -> aggregate -> sink
+        streaming pipeline server-side as ONE gated request (round 18).
+        ``source``/``stages``/``sink`` follow the
+        ``relational/pipeline.py`` spec grammar (``graph`` values are
+        GraphDef bytes; join stages reference registered frames by
+        ``build_frame_id``).  The reply carries the result frame's id +
+        schema (aggregate / collect sinks), the parquet sink summary,
+        and one ledger snapshot PER WINDOW — per-window attribution
+        that sums to this request's ``attribution()`` ledger (past 512
+        windows the tail folds into one synthetic ``folded_windows``
+        entry, so the sum stays exact).  Path-based parquet
+        sources/sinks touch the SERVER's filesystem and are refused
+        unless under a ``TFS_BRIDGE_PIPELINE_PATHS`` root; registered
+        frames (``frame_id``) always work.  The request's
+        ``deadline_ms`` cancels the pipeline at the next window
+        boundary; complete windows (and a parquet sink's finalized
+        file) survive."""
+        r = self.call(
+            "pipeline",
+            deadline_ms=deadline_ms,
+            source=dict(source),
+            stages=[dict(s) for s in stages],
+            sink=dict(sink) if sink else None,
+        )
+        if "frame_id" in r:
+            r["frame"] = RemoteFrame(self, r["frame_id"], r["schema"])
+        return r
+
     def create_frame(
         self,
         columns: Mapping[str, Any],
@@ -723,12 +758,14 @@ class RemoteFrame:
     def check(
         self,
         verb: str,
-        graph: bytes,
+        graph: Optional[bytes] = None,
         fetches: Optional[Sequence[str]] = None,
         inputs: Optional[Mapping[str, str]] = None,
         shapes: Optional[Mapping[str, Sequence[int]]] = None,
         keys: Optional[Sequence[str]] = None,
         trim: bool = False,
+        right: Optional["RemoteFrame"] = None,
+        how: str = "inner",
         deadline_ms: Optional[float] = None,
     ) -> List[Dict[str, Any]]:
         """Pre-dispatch contract verification (round 17): statically
@@ -736,7 +773,9 @@ class RemoteFrame:
         the ``TFSxxx`` diagnostics — UNGATED server-side, so a tenant
         can validate while the server is saturated, before burning an
         admission slot (and a retry budget) on a request the verb would
-        refuse."""
+        refuse.  Round 18: ``verb`` may be ``join``/``shuffle`` (no
+        graph; ``keys`` names the key column, ``right`` the build-side
+        handle), returning the relational ``TFS14x`` contracts."""
         r = self._c.call(
             "check",
             frame_id=self.frame_id,
@@ -747,6 +786,8 @@ class RemoteFrame:
             shapes=dict(shapes or {}),
             keys=list(keys or []),
             trim=trim,
+            right_frame_id=right.frame_id if right is not None else None,
+            how=how,
             deadline_ms=deadline_ms,
         )
         return r["diagnostics"]

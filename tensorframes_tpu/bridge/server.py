@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import socket
 import socketserver
 import threading
@@ -61,13 +62,18 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from .. import cancellation, faults, observability
-from ..envutil import env_float as _env_float, env_int as _env_int
+from ..envutil import (
+    env_float as _env_float,
+    env_int as _env_int,
+    env_raw as _env_raw,
+)
 from ..analyze import analyze as _analyze
 from ..builder import OpBuilder
 from ..frame import TensorFrame
 from ..ops import bucketing, device_pool, frame_cache
 from ..ops import engine as _engine_mod
 from ..ops.engine import GroupedFrame
+from ..ops.validation import ValidationError
 from . import coalescer as _coalescer
 from .protocol import (
     PROTOCOL_VERSION,
@@ -86,6 +92,13 @@ ENV_QUEUE_DEPTH = "TFS_BRIDGE_QUEUE_DEPTH"
 ENV_DRAIN_S = "TFS_BRIDGE_DRAIN_S"
 ENV_MAX_FRAMES = "TFS_BRIDGE_MAX_FRAMES"
 ENV_SESSION_TTL_S = "TFS_BRIDGE_SESSION_TTL_S"
+# round 18: colon-separated directory roots a pipeline RPC's path-based
+# parquet source/sink may touch; unset = path access refused (frame_id
+# sources and frame/collect sinks are always allowed)
+ENV_PIPELINE_PATHS = "TFS_BRIDGE_PIPELINE_PATHS"
+# per-reply cap on pipeline window-ledger snapshots; the tail past the
+# cap folds into one synthetic entry so counter sums stay exact
+_PIPELINE_WINDOW_SNAPS = 512
 
 DEFAULT_MAX_INFLIGHT = 8  # 0 = unlimited (admission gate off)
 DEFAULT_QUEUE_DEPTH = 16  # waiters allowed while inflight is full
@@ -120,6 +133,12 @@ _GATED_METHODS = frozenset(
         # round 16: registers + AOT-primes a program's (bucket, device)
         # executable grid — it compiles, so it pays admission like a verb
         "warm",
+        # round 18: a whole source -> map -> join -> aggregate -> sink
+        # streaming pipeline as ONE gated request — it compiles and
+        # dispatches per window, so it pays admission, runs under the
+        # request's cancel scope (checkpointed at every window
+        # boundary), and attributes per window through nested ledgers
+        "pipeline",
     }
 )
 
@@ -541,6 +560,108 @@ class _Session:
         self.frames.pop(frame_id, None)
         return {}
 
+    @staticmethod
+    def _check_pipeline_paths(source, sink) -> None:
+        """Path-based pipeline sources/sinks touch the SERVER's
+        filesystem — the only bridge surface that does — so they are
+        refused unless the path falls under one of the operator-
+        configured ``TFS_BRIDGE_PIPELINE_PATHS`` roots (colon-
+        separated).  Registered frames (``frame_id`` sources, frame /
+        collect sinks) need no filesystem access and are always
+        allowed."""
+        wants = []
+        if isinstance(source, dict) and "parquet" in source:
+            wants.append(("source", source["parquet"]))
+        if isinstance(sink, dict) and sink.get("kind") == "parquet":
+            wants.append(("sink", sink.get("path")))
+        if not wants:
+            return
+        roots = [
+            os.path.realpath(r)
+            for r in _env_raw(ENV_PIPELINE_PATHS, "").split(":")
+            if r
+        ]
+        for what, p in wants:
+            rp = os.path.realpath(str(p))
+            if not any(
+                rp == root or rp.startswith(root.rstrip("/") + "/")
+                for root in roots
+            ):
+                raise ValidationError(
+                    f"bridge pipeline {what} path {str(p)!r} is not "
+                    f"under any {ENV_PIPELINE_PATHS} root "
+                    f"({roots or 'none configured'}); path-based "
+                    f"sources/sinks read/write the server's "
+                    f"filesystem — register a frame and use frame_id "
+                    f"(or a collect sink) instead, or have the "
+                    f"operator allow the directory"
+                )
+
+    def pipeline(self, source=None, stages=None, sink=None):
+        """The gated ``pipeline`` RPC (round 18): execute a declarative
+        source -> map -> join -> aggregate -> sink streaming pipeline
+        (``relational/pipeline.py``) against this session's frames.
+        Key-column contracts are verified BEFORE the first window
+        dispatches (the ``tfs.check`` TFS14x codes ride the refusal);
+        per-window ledgers nest under this request's ledger, so the
+        returned window attributions sum to the request's counters
+        delta.  The result frame (aggregate / collect sinks) registers
+        in the session like any verb output."""
+        from ..relational import run_stream_pipeline
+
+        self._check_pipeline_paths(source, sink)
+        out = run_stream_pipeline(
+            source,
+            stages=stages,
+            sink=sink,
+            frames=self.frames,
+            engine=self.engine,
+        )
+        snaps = out["windows"]
+        if len(snaps) > _PIPELINE_WINDOW_SNAPS:
+            # bound the reply without breaking the exact-sum contract:
+            # the tail's snapshots FOLD into one synthetic entry, so
+            # summing the returned windows' counters still equals the
+            # request's attribution ledger
+            head = snaps[: _PIPELINE_WINDOW_SNAPS - 1]
+            tail = snaps[_PIPELINE_WINDOW_SNAPS - 1 :]
+            folded: Dict[str, Any] = {
+                "correlation_id": (
+                    tail[0]["correlation_id"] + "+"
+                ),
+                "tenant": tail[0]["tenant"],
+                "method": tail[0]["method"],
+                "folded_windows": len(tail),
+                "wall_s": round(sum(s["wall_s"] for s in tail), 6),
+                "rows": sum(s["rows"] for s in tail),
+                "counters": {},
+                "blocks_per_device": {},
+                "latency": {},
+            }
+            for s in tail:
+                for k, n in s["counters"].items():
+                    folded["counters"][k] = (
+                        folded["counters"].get(k, 0) + n
+                    )
+                for d, n in s["blocks_per_device"].items():
+                    folded["blocks_per_device"][d] = (
+                        folded["blocks_per_device"].get(d, 0) + n
+                    )
+            snaps = head + [folded]
+        reply: Dict[str, Any] = {
+            "rows": out["rows"],
+            "windows": snaps,
+            "window_count": len(out["windows"]),
+            "diagnostics": out["diagnostics"],
+            "sink": out["sink"],
+        }
+        frame = out.get("frame")
+        if frame is not None:
+            fid = self.register(frame)
+            reply["frame_id"] = fid
+            reply["schema"] = self._schema(frame)
+        return reply
+
     def check(
         self,
         frame_id: int,
@@ -551,6 +672,8 @@ class _Session:
         shapes=None,
         keys=None,
         trim: bool = False,
+        right_frame_id=None,
+        how: str = "inner",
     ):
         """Pre-dispatch contract verification (``tfs.check``, round 17):
         validate a program against a registered frame WITHOUT paying
@@ -582,6 +705,14 @@ class _Session:
             inputs=dict(inputs) if inputs else None,
             shapes=dict(shapes) if shapes else None,
             keys=list(keys) if keys else None,
+            # round 18: the relational verbs (join/shuffle) validate
+            # key contracts against a second registered frame
+            right=(
+                self.frame(right_frame_id)
+                if right_frame_id is not None
+                else None
+            ),
+            how=how,
         )
         return {"diagnostics": [d.as_dict() for d in diags]}
 
@@ -625,6 +756,12 @@ def _error_payload(e: BaseException) -> Dict[str, Any]:
         payload["code"] = e.code
         for k, v in e.extra.items():
             payload[k] = v
+    elif isinstance(getattr(e, "code", None), str):
+        # dispatch-time TFSxxx codes (ValidationError / GraphImportError
+        # / UnsupportedOpError, round 17) ride the wire too, so a
+        # front-end can branch on the same code whether it validated
+        # early (the check RPC) or failed late
+        payload["code"] = e.code
     return payload
 
 

@@ -47,6 +47,12 @@ Rules (each fires at most one diagnostic):
   shedding or queueing: the hog is starving the small tenants.  Set
   ``TFS_BRIDGE_FAIR_ROWS`` so the SLO scheduler enforces per-tenant
   budgets.
+* **shuffle_skew** (round 18) — one shuffle partition holds >= 4x the
+  median partition's rows: a hot key hashed every duplicate into one
+  partition, so the sort-merge join serializes there and that
+  partition's memory bound blows past total/partitions.  The advice
+  names the key and ``TFS_SHUFFLE_PARTITIONS`` (evidence:
+  ``relational.recent_shuffle_stats()``, injectable as ``shuffles=``).
 * **indep_probe_churn** (round 17) — row-independence questions keep
   falling back to the per-size compile probe instead of being answered
   by the static classifier (``analysis/rowdep.py``): every new bucket
@@ -82,6 +88,7 @@ SHED_RATE = 0.10
 TAIL_RATIO = 32.0  # p99 / p50
 COALESCE_MISS_RATE = 0.5  # solo dispatches / coalescer-eligible requests
 UNFAIR_ROW_RATIO = 4.0  # top tenant rows vs the runner-up
+SHUFFLE_SKEW_RATIO = 4.0  # largest shuffle partition vs the median
 
 
 def _diag(
@@ -397,6 +404,43 @@ def _rule_unfair_tenant(c, tenants) -> Optional[Dict[str, Any]]:
     )
 
 
+def _rule_shuffle_skew(shuffles) -> Optional[Dict[str, Any]]:
+    """One shuffle partition carrying >= 4x the median partition's rows:
+    the key's hash distribution is lumpy (usually a hot key), so the
+    sort-merge join / downstream consumer serializes on that partition
+    and its memory bound blows past total/partitions."""
+    worst = None
+    for s in shuffles or ():
+        rows = [int(r) for r in s.get("partition_rows") or ()]
+        if len(rows) < 2 or sum(rows) < MIN_EVENTS:
+            continue
+        ranked = sorted(rows)
+        med = max(1, ranked[len(ranked) // 2])
+        top = ranked[-1]
+        if top >= SHUFFLE_SKEW_RATIO * med and (
+            worst is None or top / med > worst[1]
+        ):
+            worst = (s.get("key"), top / med, top, med, rows)
+    if worst is None:
+        return None
+    key, ratio, top, med, rows = worst
+    return _diag(
+        "shuffle_skew",
+        "warn",
+        f"shuffle on key {key!r} is skewed: the largest partition holds "
+        f"{top} rows, {ratio:.0f}x the median partition's {med} "
+        f"(per-partition {rows})",
+        {"key": key, "partition_rows": rows, "max_rows": top,
+         "median_rows": med, "skew_ratio": round(ratio, 2)},
+        "TFS_SHUFFLE_PARTITIONS",
+        f"a hot value in key {key!r} hashes every duplicate into one "
+        f"partition; raising TFS_SHUFFLE_PARTITIONS shrinks every OTHER "
+        f"partition's memory bound but not the hot one's — prefer a "
+        f"higher-cardinality key (or salt the hot key upstream), and "
+        f"budget the sort-merge join for the largest partition's rows",
+    )
+
+
 def _rule_indep_probe_churn(c) -> Optional[Dict[str, Any]]:
     falls = c.get("analysis_probe_fallbacks", 0)
     hits = c.get("analysis_static_hits", 0)
@@ -426,6 +470,7 @@ def doctor(
     ledger: Optional[Mapping[str, Any]] = None,
     spans: Optional[Sequence[Mapping[str, Any]]] = None,
     tenants: Optional[Mapping[str, Mapping[str, Any]]] = None,
+    shuffles: Optional[Sequence[Mapping[str, Any]]] = None,
 ) -> List[Dict[str, Any]]:
     """Diagnose the process's (or the given snapshots') performance
     state.  Returns structured diagnostics, worst first — each names
@@ -449,6 +494,13 @@ def doctor(
         spans = observability.last_spans(64)
     if tenants is None:
         tenants = observability.request_metrics()
+    if shuffles is None:
+        try:  # lazy: relational imports streaming/ops, never the reverse
+            from .relational import recent_shuffle_stats
+
+            shuffles = recent_shuffle_stats()
+        except Exception:  # noqa: BLE001 — diagnosis must never fail here
+            shuffles = []
     out: List[Dict[str, Any]] = []
     for rule in (
         lambda: _rule_shed_burn(c),
@@ -459,6 +511,7 @@ def doctor(
         lambda: _rule_retry_burn(c),
         lambda: _rule_unfair_tenant(c, tenants),
         lambda: _rule_coalesce_miss(c),
+        lambda: _rule_shuffle_skew(shuffles),
         lambda: _rule_indep_probe_churn(c),
         lambda: _rule_slow_tail(lat),
     ):

@@ -85,6 +85,11 @@ class Column:
     def is_ragged(self) -> bool:
         if isinstance(self.data, np.ndarray):
             return self.data.dtype == object
+        if getattr(self.data, "_tfs_released", False):
+            # a released windowed column (ops/frame_cache.py round 18):
+            # uniform by construction — only device-feedable contiguous
+            # columns are ever cached, hence ever released
+            return False
         return not is_device_array(self.data)
 
     @property
@@ -532,7 +537,18 @@ class TensorFrame:
                     out._host_windowed = getattr(
                         self, "_host_windowed", False
                     )
-                    return frame_cache.attach(out, cache)
+                    frame_cache.attach(out, cache)
+                    if spill is not None and (
+                        frame_cache.release_host_enabled()
+                    ):
+                        # round 18: a windowed frame's bytes now all
+                        # have a durable home (HBM shard, or disk via
+                        # the spill-backed eviction path), so the host
+                        # copies stop pinning RAM — the frame object
+                        # stays fully usable through the lazy
+                        # spill-backed stand-ins
+                        frame_cache.release_host_columns(out)
+                    return out
         staged = prefetch.stage_columns(host, device)
         cols = [
             Column(c.info, staged[c.info.name])
@@ -546,10 +562,18 @@ class TensorFrame:
         """Materialise device-resident columns back to host numpy; a
         sharded cache (``cache(sharded=True)``) is released — its shards
         drop out of the ``TFS_HBM_BUDGET`` accounting — and the
-        authoritative host columns carry over unchanged."""
+        authoritative host columns carry over unchanged.  Released
+        windowed columns (round 18) re-materialise to real host arrays
+        BEFORE the cache (and its spill files) goes away."""
         from .ops import frame_cache
 
         cache = getattr(self, "_cache", None)
+        for c in self._columns:
+            if frame_cache.is_released(c.data):
+                # in place: the data objects are shared with the frame
+                # this one was derived from, which must not be left
+                # pointing at a released cache
+                c.data = np.asarray(c.data)
         if cache is not None:
             cache.release()
             frame_cache.attach(self, None)

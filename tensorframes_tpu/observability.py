@@ -163,6 +163,15 @@ _counters: Dict[str, int] = {
     # probe — the ratio tfs.doctor()'s ``indep_probe_churn`` rule reads
     "analysis_static_hits": 0,
     "analysis_probe_fallbacks": 0,
+    # relational verbs (round 18, tensorframes_tpu/relational/): shuffle
+    # spill-run traffic and join build/probe volume — the evidence that a
+    # re-key ran through disk runs (not host RAM) and which join side did
+    # the work; the ``shuffle_skew`` doctor rule reads the per-partition
+    # stats the shuffle module keeps alongside these totals
+    "shuffle_partitions_written": 0,
+    "shuffle_bytes_spilled": 0,
+    "join_build_rows": 0,
+    "join_probe_rows": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
 
@@ -715,6 +724,31 @@ def note_analysis_probe_fallback() -> None:
     _bump("analysis_probe_fallbacks")
 
 
+def note_shuffle_partition_written(n: int = 1) -> None:
+    """``n`` per-partition spill runs written by the streaming shuffle
+    (``relational/shuffle.py``) — one run per (window, non-empty
+    partition)."""
+    _bump("shuffle_partitions_written", int(n))
+
+
+def note_shuffle_bytes_spilled(n: int) -> None:
+    """``n`` bytes of shuffle run payload written to ``TFS_SPILL_DIR``
+    (also counted in ``spill_bytes_written`` by the store; this counter
+    isolates the shuffle's share)."""
+    _bump("shuffle_bytes_spilled", int(n))
+
+
+def note_join_build_rows(n: int) -> None:
+    """``n`` build-side rows indexed by a join (once per broadcast
+    build; once per partition for sort-merge)."""
+    _bump("join_build_rows", int(n))
+
+
+def note_join_probe_rows(n: int) -> None:
+    """``n`` probe-side rows streamed through a join."""
+    _bump("join_probe_rows", int(n))
+
+
 def note_stream_window() -> None:
     """One streamed window materialised into host columns by the
     windowed reader (``streaming/reader.py``)."""
@@ -866,6 +900,10 @@ def counters_delta(
             "slo_sheds",
             "analysis_static_hits",
             "analysis_probe_fallbacks",
+            "shuffle_partitions_written",
+            "shuffle_bytes_spilled",
+            "join_build_rows",
+            "join_probe_rows",
         )
     }
 

@@ -292,6 +292,23 @@ class FrameCache:
         self.blocks[bi] = None
         self.nbytes[bi] = 0
 
+    def block_host(self, bi: int, name: str) -> np.ndarray:
+        """Block ``bi``'s column ``name`` as a HOST array, read from the
+        resident shard or the spill file WITHOUT charging the budget —
+        the read-only materialisation path behind released host columns
+        (:class:`SpillBackedColumnData`)."""
+        s = self.blocks[bi]
+        if s is not None and name in s:
+            return np.asarray(s[name])
+        if self.spill is not None and bi in self._spilled:
+            host = self.spill.get(self._spill_key(bi))
+            if host is not None and name in host:
+                return host[name]
+        raise RuntimeError(
+            f"released column {name!r}: block {bi} has neither a "
+            f"resident shard nor a spill copy (spill file lost?)"
+        )
+
     def release(self) -> None:
         """Drop every shard and refund the budget (``uncache()``)."""
         _budget.release(self)
@@ -413,6 +430,179 @@ def budget_bytes_resident() -> int:
     with _budget._lock:
         _budget._prune()
     return _budget.total_bytes
+
+
+# ---------------------------------------------------------------------------
+# host-column release for windowed frames (round 18)
+# ---------------------------------------------------------------------------
+#
+# A windowed frame's host columns were, until this round, pinned for the
+# frame object's whole lifetime even after a spill-backed sharded cache
+# held every byte in HBM or on disk — defeating the HBM-resident path
+# for epochs over windowed frames (the round-12 "known scope limit").
+# ``release_host_columns`` swaps the cached columns' host arrays for a
+# lazy stand-in that re-materialises block slices from the shard / spill
+# copies on demand, so the frame stays fully usable (any verb, any
+# fallback path) while its host bytes drop to zero.
+
+ENV_RELEASE_HOST = "TFS_RELEASE_HOST"
+
+
+def release_host_enabled() -> bool:
+    """``TFS_RELEASE_HOST``: unset/``auto`` = release windowed frames'
+    host columns once a spill-backed sharded cache covers them;
+    ``0``/``off`` = keep the pre-round-18 pinning."""
+    raw = envutil.env_raw(ENV_RELEASE_HOST, "auto").lower()
+    return raw not in ("0", "off", "false", "no")
+
+
+class SpillBackedColumnData:
+    """Lazy host stand-in for a released windowed column: ``len`` /
+    ``shape`` / ``dtype`` answer from metadata, slicing re-materialises
+    exactly the covering blocks from the cache's shard or spill copies
+    (``FrameCache.block_host``), and ``__array__`` rebuilds the whole
+    column — so every host fallback path still works, it just pays a
+    read instead of holding the bytes."""
+
+    _tfs_released = True
+
+    def __init__(self, cache: FrameCache, name: str, offsets, dtype,
+                 cell_shape):
+        self._cache = cache
+        self._name = name
+        self._offsets = tuple(int(o) for o in offsets)
+        self.dtype = np.dtype(dtype)
+        self._cell = tuple(int(d) for d in cell_shape)
+        self._n = self._offsets[-1]
+
+    @property
+    def shape(self):
+        return (self._n,) + self._cell
+
+    @property
+    def ndim(self) -> int:
+        return 1 + len(self._cell)
+
+    @property
+    def nbytes(self) -> int:
+        total = self._n * self.dtype.itemsize
+        for d in self._cell:
+            total *= d
+        return total
+
+    def __len__(self) -> int:
+        return self._n
+
+    def _materialize(self, start: int, stop: int) -> np.ndarray:
+        if start >= stop:
+            return np.empty((0,) + self._cell, self.dtype)
+        offs = self._offsets
+        parts = []
+        for bi in range(len(offs) - 1):
+            lo, hi = offs[bi], offs[bi + 1]
+            if hi <= start or lo >= stop:
+                continue
+            block = self._cache.block_host(bi, self._name)
+            parts.append(block[max(start - lo, 0):stop - lo])
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            start, stop, step = idx.indices(self._n)
+            if step != 1:
+                return self._materialize(0, self._n)[idx]
+            return self._materialize(start, stop)
+        if isinstance(idx, (int, np.integer)):
+            i = int(idx)
+            if i < 0:
+                i += self._n
+            return self._materialize(i, i + 1)[0]
+        # fancy indexing and everything else: full materialisation
+        return self._materialize(0, self._n)[idx]
+
+    def __iter__(self):
+        offs = self._offsets
+        for bi in range(len(offs) - 1):
+            if offs[bi + 1] > offs[bi]:
+                yield from self._cache.block_host(bi, self._name)
+
+    def __array__(self, dtype=None, copy=None):
+        arr = self._materialize(0, self._n)
+        return arr if dtype is None else arr.astype(dtype)
+
+    def __repr__(self):
+        return (
+            f"SpillBackedColumnData[{self._name}: shape={self.shape}, "
+            f"{self.dtype}]"
+        )
+
+
+def is_released(data) -> bool:
+    """Whether ``data`` is a released-column stand-in."""
+    return getattr(data, "_tfs_released", False)
+
+
+def release_host_columns(frame) -> int:
+    """Release ``frame``'s cached host column arrays: every cached
+    block's bytes are guaranteed a durable home first (resident shards
+    spill on eviction; never-resident blocks are spilled here), then
+    each cached column's ``data`` becomes a :class:`SpillBackedColumnData`.
+    Returns the host bytes released (0 when nothing was releasable).
+
+    Requires a spill-backed sharded cache whose block count matches the
+    frame — anything else leaves the frame untouched (host columns
+    without a disk fallback must stay authoritative)."""
+    cache = getattr(frame, "_cache", None)
+    if (
+        cache is None
+        or cache.spill is None
+        or len(cache.assignment) != frame.num_blocks
+    ):
+        return 0
+    cached_names = None
+    for shard in cache.blocks:
+        if shard is not None:
+            cached_names = set(shard)
+            break
+    if cached_names is None:
+        # nothing resident: names come from the spill copies, or give up
+        for bi in sorted(cache._spilled):
+            host = cache.spill.get(cache._spill_key(bi))
+            if host is not None:
+                cached_names = set(host)
+                break
+    if not cached_names:
+        return 0
+    # durability first: a block that never fit the budget (insert
+    # refused) has neither shard nor spill copy — write it now, from
+    # the host bytes we are about to drop
+    for bi in range(frame.num_blocks):
+        if cache.blocks[bi] is None and bi not in cache._spilled:
+            block = frame.block(bi)
+            host = {
+                n: np.asarray(block[n]) for n in sorted(cached_names)
+            }
+            cache.spill.put(cache._spill_key(bi), host)
+            cache._spilled.add(bi)
+    released = 0
+    for col in frame.columns:
+        name = col.info.name
+        d = col.data
+        if (
+            name in cached_names
+            and isinstance(d, np.ndarray)
+            and d.dtype != object
+        ):
+            released += d.nbytes
+            col.data = SpillBackedColumnData(
+                cache, name, frame.offsets, d.dtype, d.shape[1:]
+            )
+    if released:
+        observability.trace_instant(
+            "release_host", "cache", bytes=released,
+            blocks=frame.num_blocks,
+        )
+    return released
 
 
 # ---------------------------------------------------------------------------

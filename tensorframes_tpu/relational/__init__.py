@@ -1,0 +1,53 @@
+"""Relational verbs over streaming frames (round 18).
+
+The reference's verb surface has per-partition maps and cross-partition
+reduces but no way to RE-KEY or COMBINE two frames — and the PR 7
+streaming layer inherited the gap.  This subsystem closes it with three
+legs, each documented in its module:
+
+* :mod:`~tensorframes_tpu.relational.shuffle` — fixed-memory streaming
+  shuffle/repartition through the disk spill store
+  (``TFS_SHUFFLE_PARTITIONS``);
+* :mod:`~tensorframes_tpu.relational.join` — windowed joins
+  (broadcast-hash via the sharded frame cache; sort-merge over shuffle
+  spill runs), bit-identical to the materialized reference
+  :func:`join_frames`;
+* :mod:`~tensorframes_tpu.relational.pipeline` — declarative
+  source -> map -> join -> aggregate -> sink pipelines, served by the
+  bridge's gated ``pipeline`` RPC with per-window attribution.
+
+See docs/RELATIONAL.md for strategies, knobs, and failure modes.
+"""
+
+from .join import (
+    BroadcastJoinStream,
+    SortMergeJoinStream,
+    join,
+    join_frames,
+)
+from .pipeline import check_pipeline, run_stream_pipeline
+from .shuffle import (
+    PartitionStream,
+    ShuffledFrame,
+    key_hashes,
+    partition_ids,
+    recent_shuffle_stats,
+    reset_shuffle_stats,
+    shuffle,
+)
+
+__all__ = [
+    "BroadcastJoinStream",
+    "PartitionStream",
+    "ShuffledFrame",
+    "SortMergeJoinStream",
+    "check_pipeline",
+    "join",
+    "join_frames",
+    "key_hashes",
+    "partition_ids",
+    "recent_shuffle_stats",
+    "reset_shuffle_stats",
+    "run_stream_pipeline",
+    "shuffle",
+]

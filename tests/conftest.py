@@ -115,6 +115,21 @@ os.environ.setdefault("TFS_BRIDGE_CLIENT_BUSY_RETRIES", "")
 # absence-default like every other tier's knobs.
 os.environ.setdefault("TFS_PLAN", "0")
 
+# Relational verbs (round 18, tensorframes_tpu/relational/): shuffle,
+# windowed joins, and bridge pipelines stay at their inert defaults in
+# the main suite — shuffle needs TFS_SPILL_DIR (pinned empty above), so
+# relational tests pass explicit spill stores / monkeypatch; the
+# run_tests.sh relational tier re-runs them with the TFS_SHUFFLE_* /
+# TFS_JOIN_* knobs live.  TFS_RELEASE_HOST's absence default is AUTO
+# (release a windowed frame's host columns once a spill-backed sharded
+# cache covers them) — deterministic, so no off-pin is needed.
+os.environ.setdefault("TFS_SHUFFLE_PARTITIONS", "")
+os.environ.setdefault("TFS_JOIN_BROADCAST_BYTES", "")
+os.environ.setdefault("TFS_RELEASE_HOST", "")
+# absence default = NO filesystem roots allowed to the bridge pipeline
+# RPC's path-based sources/sinks; bridge tests allow their tmp dirs
+os.environ.setdefault("TFS_BRIDGE_PIPELINE_PATHS", "")
+
 # Static program analysis (round 17, tensorframes_tpu/analysis/): the
 # classifier itself is deterministic and its traces are suppressed from
 # the retrace counters, so it stays ON (empty = absence default = on) —

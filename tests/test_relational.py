@@ -1,0 +1,923 @@
+"""Relational verbs (``tensorframes_tpu/relational/``, round 18).
+
+Pins the round-18 contracts:
+
+* the streaming shuffle hash-partitions deterministically, keeps rows in
+  stream order per partition, round-trips every column kind bit-exactly
+  through its spill runs, and discards runs ATOMICALLY on mid-shuffle
+  cancellation;
+* shuffle-then-reduce is bit-identical to the materialized reference
+  with the same block boundaries;
+* both join strategies (broadcast-hash, sort-merge over spill runs) are
+  bit-identical to the materialized reference join — broadcast in row
+  order, sort-merge as the reference reordered stably by partition id —
+  including uneven tails, left-join fills, and a chaos leg;
+* re-keying a frame >= 4x ``TFS_HOST_BUDGET`` keeps ``peak_host_bytes``
+  bounded at the budget;
+* ``tfs.check`` returns the TFS14x relational codes (and the bridge
+  ``check`` RPC serves them);
+* the bridge ``pipeline`` RPC runs source -> map -> join -> aggregate
+  end to end with per-window attribution summing to the request's
+  ledger;
+* a windowed frame's host columns release once a spill-backed sharded
+  cache covers them (``TFS_RELEASE_HOST``).
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import cancellation, observability as obs, relational
+from tensorframes_tpu import streaming
+from tensorframes_tpu.frame import TensorFrame
+from tensorframes_tpu.ops.validation import ValidationError
+from tensorframes_tpu.relational import shuffle as shuffle_mod
+from tensorframes_tpu.streaming import SpillStore
+
+N_ROWS = 1000
+WINDOW = 300  # uneven tail: 300/300/300/100
+KEYS = 7
+
+
+@pytest.fixture()
+def spill(tmp_path):
+    return SpillStore(str(tmp_path / "spill"))
+
+
+@pytest.fixture()
+def pq_path(tmp_path):
+    rng = np.random.RandomState(11)
+    frame = tfs.TensorFrame.from_arrays(
+        {
+            "k": rng.randint(0, KEYS, N_ROWS).astype(np.int64),
+            # small integers: float sums are exact in any association
+            "x": rng.randint(0, 16, (N_ROWS, 4)).astype(np.float64),
+        }
+    )
+    path = tmp_path / "rel.parquet"
+    frame.to_parquet(path, row_group_size=128)
+    return str(path)
+
+
+@pytest.fixture()
+def build_frame():
+    return tfs.TensorFrame.from_arrays(
+        {
+            "k": np.arange(KEYS, dtype=np.int64),
+            "w": (np.arange(KEYS, dtype=np.float64) + 1.0) * 10.0,
+        }
+    )
+
+
+def _scan(path, **kw):
+    kw.setdefault("window_rows", WINDOW)
+    return streaming.scan_parquet(path, **kw)
+
+
+def _rows(frame):
+    """Frame rows as comparable tuples (column order fixed by name)."""
+    arrs = {
+        n: np.asarray(frame.column(n).data) for n in frame.column_names
+    }
+    names = sorted(arrs)
+    return [
+        tuple(
+            arrs[n][i].tobytes()
+            if isinstance(arrs[n][i], np.ndarray)
+            else arrs[n][i]
+            for n in names
+        )
+        for i in range(frame.num_rows)
+    ]
+
+
+def _concat_windows(stream):
+    blocks = [
+        {n: np.asarray(v) for n, v in wf.block(bi).items()}
+        for wf in stream.windows()
+        for bi in range(wf.num_blocks)
+    ]
+    return TensorFrame.from_blocks(blocks) if blocks else None
+
+
+# ---------------------------------------------------------------------------
+# shuffle
+# ---------------------------------------------------------------------------
+
+
+def test_partition_ids_deterministic_and_in_range():
+    keys = np.arange(-500, 500, dtype=np.int64)
+    a = relational.partition_ids(keys, 8)
+    b = relational.partition_ids(keys, 8)
+    np.testing.assert_array_equal(a, b)
+    assert a.min() >= 0 and a.max() < 8
+    assert len(np.unique(a)) > 1  # spread, not collapsed
+
+
+def test_shuffle_partitions_rows_by_stable_hash(pq_path, spill):
+    P = 4
+    sh = relational.shuffle(_scan(pq_path), "k", partitions=P, spill=spill)
+    full = tfs.TensorFrame.from_parquet(pq_path)
+    expect_pids = relational.partition_ids(
+        np.asarray(full.column("k").data), P
+    )
+    total = 0
+    for p in range(P):
+        part = _concat_windows(sh.partition(p))
+        if part is None:
+            assert (expect_pids == p).sum() == 0
+            continue
+        total += part.num_rows
+        got_k = np.asarray(part.column("k").data)
+        # every row landed in its hash's partition...
+        np.testing.assert_array_equal(
+            relational.partition_ids(got_k, P), np.full(len(got_k), p)
+        )
+        # ...in original stream order, bit-exactly (k AND payload)
+        mask = expect_pids == p
+        np.testing.assert_array_equal(
+            got_k, np.asarray(full.column("k").data)[mask]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(part.column("x").data),
+            np.asarray(full.column("x").data)[mask],
+        )
+    assert total == N_ROWS
+    assert sh.partition_rows == [
+        int((expect_pids == p).sum()) for p in range(P)
+    ]
+
+
+def test_shuffle_then_reduce_bit_identity(pq_path, spill):
+    """Reducing the re-keyed stream == reducing the materialized
+    re-keyed frame with the SAME block boundaries (one block per run)."""
+    sh = relational.shuffle(_scan(pq_path), "k", partitions=3, spill=spill)
+    fn = lambda x_input: {"x": x_input.sum(0)}  # noqa: E731
+    got = streaming.reduce_blocks(fn, sh.stream())
+    blocks = [
+        {n: np.asarray(v) for n, v in wf.block(bi).items()}
+        for wf in sh.stream().windows()
+        for bi in range(wf.num_blocks)
+    ]
+    ref = tfs.reduce_blocks(fn, TensorFrame.from_blocks(blocks))
+    np.testing.assert_array_equal(got["x"], ref["x"])
+
+
+def test_shuffle_counters_and_reiteration(pq_path, spill):
+    c0 = obs.counters()
+    sh = relational.shuffle(_scan(pq_path), "k", partitions=4, spill=spill)
+    d = obs.counters_delta(c0)
+    assert d["shuffle_partitions_written"] > 0
+    assert d["shuffle_bytes_spilled"] > 0
+    assert d["spill_bytes_written"] >= d["shuffle_bytes_spilled"]
+    # partitions replay from disk: two passes, identical bytes
+    first = _rows(_concat_windows(sh.partition(0)))
+    second = _rows(_concat_windows(sh.partition(0)))
+    assert first == second
+    # release drops the runs
+    key0 = sh.run_keys[0][0]
+    sh.release()
+    assert spill.get(key0) is None
+
+
+def test_shuffle_binary_columns_bit_exact(spill):
+    cells = [b"a\x00", b"", b"xy\x00\x00", b"q", b"a\x00"]
+    # object-array construction: a plain byte list would go through
+    # numpy's fixed-width 'S' dtype, which strips trailing NULs before
+    # the shuffle ever sees them
+    barr = np.empty(len(cells), dtype=object)
+    barr[:] = cells
+    frame = tfs.TensorFrame.from_arrays(
+        {"k": np.array([1, 2, 1, 2, 1], np.int64), "b": barr}
+    )
+    sh = relational.shuffle(frame, "k", partitions=2, spill=spill)
+    got = []
+    for p in range(2):
+        part = _concat_windows(sh.partition(p))
+        if part is not None:
+            got.extend(bytes(c) for c in part.column("b").cells())
+    # trailing NULs survive the run encoding exactly
+    assert sorted(got) == sorted(cells)
+
+
+def test_shuffle_requires_spill(pq_path, monkeypatch):
+    monkeypatch.setenv("TFS_SPILL_DIR", "")
+    with pytest.raises(ValidationError, match="TFS_SPILL_DIR"):
+        relational.shuffle(_scan(pq_path), "k")
+
+
+def test_shuffle_key_contracts(spill):
+    frame = tfs.TensorFrame.from_arrays({"x": np.arange(4.0)})
+    with pytest.raises(ValidationError, match="does not exist") as ei:
+        relational.shuffle(frame, "k", partitions=2, spill=spill)
+    assert ei.value.code == "TFS140"
+    ragged = tfs.TensorFrame.from_arrays(
+        {
+            "k": np.arange(3, dtype=np.int64),
+            "r": [np.zeros(2), np.zeros(3), np.zeros(2)],
+        }
+    )
+    with pytest.raises(ValidationError) as ei:
+        relational.shuffle(ragged, "k", partitions=2, spill=spill)
+    assert ei.value.code == "TFS142"
+
+
+def test_mid_shuffle_cancel_discards_runs_atomically(pq_path, spill):
+    """A deadline/cancel mid-shuffle leaves NO runs behind — a consumer
+    can never observe half a re-key (docs/RESILIENCE.md)."""
+    scope = cancellation.CancelScope(label="shuffle-test")
+    windows_seen = {"n": 0}
+
+    def cancelling_windows():
+        for wf in _scan(pq_path).windows():
+            windows_seen["n"] += 1
+            if windows_seen["n"] == 3:
+                scope.cancel("test cancel")
+            yield wf
+
+    class _FakeStream(streaming.StreamFrame):
+        def __init__(self):
+            super().__init__(
+                source=lambda: iter(()), window_rows=WINDOW,
+                reiterable=True, label="cancelling",
+            )
+
+        def windows(self):
+            return cancelling_windows()
+
+    root = spill.root
+    with cancellation.activate(scope):
+        with pytest.raises(cancellation.Cancelled):
+            relational.shuffle(
+                _FakeStream(), "k", partitions=4, spill=spill
+            )
+    assert windows_seen["n"] == 3  # stopped at the next boundary
+    leftover = [n for n in os.listdir(root) if "shufrun" in n]
+    assert leftover == []
+
+
+def test_doctor_shuffle_skew_rule():
+    diags = tfs.doctor(
+        counters={}, latency={}, spans=[], tenants={},
+        shuffles=[{"key": "hot", "partition_rows": [100, 10, 12, 9]}],
+    )
+    skew = [d for d in diags if d["code"] == "shuffle_skew"]
+    assert len(skew) == 1
+    assert "hot" in skew[0]["summary"]
+    assert skew[0]["knob"] == "TFS_SHUFFLE_PARTITIONS"
+    # balanced partitions: silent
+    diags = tfs.doctor(
+        counters={}, latency={}, spans=[], tenants={},
+        shuffles=[{"key": "k", "partition_rows": [10, 12, 9, 11]}],
+    )
+    assert not [d for d in diags if d["code"] == "shuffle_skew"]
+
+
+def test_doctor_reads_live_shuffle_stats(pq_path, spill):
+    relational.reset_shuffle_stats()
+    # a constant key: every row hashes into ONE partition
+    frame = tfs.TensorFrame.from_arrays(
+        {"k": np.zeros(64, np.int64), "x": np.arange(64.0)}
+    )
+    relational.shuffle(frame, "k", partitions=4, spill=spill)
+    diags = tfs.doctor(counters={}, latency={}, spans=[], tenants={})
+    assert [d for d in diags if d["code"] == "shuffle_skew"]
+    relational.reset_shuffle_stats()
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+
+def test_join_frames_reference_semantics():
+    left = tfs.TensorFrame.from_arrays(
+        {"k": np.array([1, 2, 2, 9], np.int64), "a": np.arange(4.0)}
+    )
+    right = tfs.TensorFrame.from_arrays(
+        {
+            "k": np.array([2, 2, 1], np.int64),
+            "b": np.array([10.0, 20.0, 30.0]),
+        }
+    )
+    inner = relational.join_frames(left, right, "k")
+    # left-major order; matches in right original order
+    np.testing.assert_array_equal(
+        np.asarray(inner.column("k").data), [1, 2, 2, 2, 2]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(inner.column("a").data), [0.0, 1.0, 1.0, 2.0, 2.0]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(inner.column("b").data),
+        [30.0, 10.0, 20.0, 10.0, 20.0],
+    )
+    left_join = relational.join_frames(left, right, "k", how="left")
+    np.testing.assert_array_equal(
+        np.asarray(left_join.column("k").data), [1, 2, 2, 2, 2, 9]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(left_join.column("b").data),
+        [30.0, 10.0, 20.0, 10.0, 20.0, 0.0],  # unmatched fills 0
+    )
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_broadcast_join_bit_identity(pq_path, build_frame, how):
+    ref = relational.join_frames(
+        tfs.TensorFrame.from_parquet(pq_path), build_frame, "k", how=how
+    )
+    js = relational.join(
+        _scan(pq_path), build_frame, on="k", how=how,
+        strategy="broadcast",
+    )
+    got = _concat_windows(js)
+    assert got.column_names == ref.column_names
+    for n in ref.column_names:
+        a, b = np.asarray(got.column(n).data), np.asarray(
+            ref.column(n).data
+        )
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_sort_merge_join_bit_identity(pq_path, build_frame, spill, how):
+    """Sort-merge output == the reference join reordered STABLY by the
+    left key's partition id — exact and reconstructible."""
+    P = 4
+    ref = relational.join_frames(
+        tfs.TensorFrame.from_parquet(pq_path), build_frame, "k", how=how
+    )
+    order = np.argsort(
+        relational.partition_ids(np.asarray(ref.column("k").data), P),
+        kind="stable",
+    )
+    js = relational.join(
+        _scan(pq_path), build_frame, on="k", how=how,
+        strategy="sort_merge", partitions=P, spill=spill,
+    )
+    got = _concat_windows(js)
+    assert got.num_rows == ref.num_rows
+    for n in ref.column_names:
+        a = np.asarray(got.column(n).data)
+        b = np.asarray(ref.column(n).data)[order]
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sort_merge_left_join_empty_right_partition(spill):
+    """Left keys whose partition holds no right rows still emit fills."""
+    left = tfs.TensorFrame.from_arrays(
+        {"k": np.arange(16, dtype=np.int64), "a": np.arange(16.0)}
+    )
+    right = tfs.TensorFrame.from_arrays(
+        {"k": np.array([0], np.int64), "b": np.array([5.0])}
+    )
+    out = relational.join(
+        left, right, on="k", how="left", strategy="sort_merge",
+        partitions=4, spill=spill,
+    )
+    assert out.num_rows == 16
+    got = {
+        int(k): float(b)
+        for k, b in zip(
+            np.asarray(out.column("k").data),
+            np.asarray(out.column("b").data),
+        )
+    }
+    assert got[0] == 5.0
+    assert all(got[k] == 0.0 for k in range(1, 16))
+
+
+def test_join_float_keys_match_on_bit_pattern(spill):
+    left = tfs.TensorFrame.from_arrays(
+        {"k": np.array([0.0, -0.0, np.nan]), "a": np.arange(3.0)}
+    )
+    right = tfs.TensorFrame.from_arrays(
+        {"k": np.array([0.0, np.nan]), "b": np.array([1.0, 2.0])}
+    )
+    out = relational.join_frames(left, right, "k", how="left")
+    np.testing.assert_array_equal(
+        np.asarray(out.column("b").data), [1.0, 0.0, 2.0]
+    )
+
+
+def test_join_contracts_and_codes(build_frame):
+    left = tfs.TensorFrame.from_arrays(
+        {"k": np.arange(4, dtype=np.int32), "w": np.arange(4.0)}
+    )
+    # dtype mismatch (int32 vs int64)
+    with pytest.raises(ValidationError) as ei:
+        relational.join_frames(left, build_frame, "k")
+    assert ei.value.code == "TFS141"
+    # non-key collision ("w" on both sides)
+    left64 = tfs.TensorFrame.from_arrays(
+        {"k": np.arange(4, dtype=np.int64), "w": np.arange(4.0)}
+    )
+    with pytest.raises(ValidationError) as ei:
+        relational.join_frames(left64, build_frame, "k")
+    assert ei.value.code == "TFS143"
+    with pytest.raises(ValidationError, match="how"):
+        relational.join_frames(left64, build_frame, "k", how="outer")
+
+
+def test_join_counters(pq_path, build_frame):
+    c0 = obs.counters()
+    _concat_windows(
+        relational.join(
+            _scan(pq_path), build_frame, on="k", strategy="broadcast"
+        )
+    )
+    d = obs.counters_delta(c0)
+    assert d["join_build_rows"] == KEYS
+    assert d["join_probe_rows"] == N_ROWS
+
+
+def test_join_auto_strategy_threshold(pq_path, build_frame, spill,
+                                      monkeypatch):
+    monkeypatch.setenv("TFS_SPILL_DIR", spill.root)
+    monkeypatch.setenv("TFS_JOIN_BROADCAST_BYTES", "1")  # nothing fits
+    js = relational.join(_scan(pq_path), build_frame, on="k")
+    assert isinstance(js, relational.SortMergeJoinStream)
+    monkeypatch.setenv("TFS_JOIN_BROADCAST_BYTES", "1M")
+    js = relational.join(_scan(pq_path), build_frame, on="k")
+    assert isinstance(js, relational.BroadcastJoinStream)
+
+
+def test_check_relational_codes(build_frame):
+    left = tfs.TensorFrame.from_arrays(
+        {"k": np.arange(4, dtype=np.int64), "v": np.arange(4.0)}
+    )
+    assert tfs.check(left, None, "join", keys=["k"], right=build_frame) == []
+    d = tfs.check(left, None, "join", keys=["zz"], right=build_frame)
+    # missing on both sides, plus "k" (not the join key here) colliding
+    assert [x.code for x in d] == ["TFS140", "TFS140", "TFS143"]
+    l32 = tfs.TensorFrame.from_arrays(
+        {"k": np.arange(4, dtype=np.int32), "v": np.arange(4.0)}
+    )
+    d = tfs.check(l32, None, "join", keys=["k"], right=build_frame)
+    assert [x.code for x in d] == ["TFS141"]
+    lw = tfs.TensorFrame.from_arrays(
+        {"k": np.arange(4, dtype=np.int64), "w": np.arange(4.0)}
+    )
+    d = tfs.check(lw, None, "join", keys=["k"], right=build_frame)
+    assert [x.code for x in d] == ["TFS143"]
+    ragged = tfs.TensorFrame.from_arrays(
+        {"r": [np.zeros(2), np.zeros(3)], "k": np.arange(2, dtype=np.int64)}
+    )
+    d = tfs.check(ragged, None, "shuffle", keys=["r"])
+    assert d and d[0].code == "TFS142"
+    assert tfs.check(ragged, None, "shuffle", keys=["k"]) == []
+
+
+# ---------------------------------------------------------------------------
+# fixed memory: re-key a frame >= 4x the host budget
+# ---------------------------------------------------------------------------
+
+
+def test_rekey_peak_host_bytes_bounded_at_budget(tmp_path, monkeypatch):
+    rows, dim = 16384, 8
+    path = tmp_path / "big.parquet"
+    rng = np.random.RandomState(3)
+    tfs.TensorFrame.from_arrays(
+        {
+            "k": rng.randint(0, 64, rows).astype(np.int64),
+            "x": rng.rand(rows, dim),
+        }
+    ).to_parquet(path, row_group_size=1024)
+    frame_bytes = rows * (dim * 8 + 8)
+    budget = 256 * 1024
+    assert frame_bytes >= 4 * budget  # the acceptance precondition
+    monkeypatch.setenv("TFS_HOST_BUDGET", str(budget))
+    spill = SpillStore(str(tmp_path / "spill"))
+    obs.reset_peak_host_bytes()
+    sh = relational.shuffle(
+        streaming.scan_parquet(str(path)), "k", partitions=4, spill=spill
+    )
+    total = sum(w.num_rows for w in sh.stream().windows())
+    assert total == rows
+    peak = obs.counters()["peak_host_bytes"]
+    assert 0 < peak <= budget
+    assert obs.live_host_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# pipelines (in-process)
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_reference(pq_path, build_frame):
+    """source -> map -> join -> aggregate, materialized."""
+    full = tfs.TensorFrame.from_parquet(pq_path)
+    mapped = tfs.map_rows(lambda x: {"y": x * 2.0}, full)
+    joined = relational.join_frames(mapped, build_frame, "k")
+    return tfs.aggregate(
+        lambda y_input, w_input: {
+            "y": y_input.sum(0), "w": w_input.sum(0)
+        },
+        tfs.group_by(joined, "k"),
+    )
+
+
+def _agg_dict(frame):
+    k = np.asarray(frame.column("k").data)
+    return {
+        int(k[i]): (
+            np.asarray(frame.column("y").data)[i].tobytes(),
+            float(np.asarray(frame.column("w").data)[i]),
+        )
+        for i in range(frame.num_rows)
+    }
+
+
+@pytest.mark.parametrize("strategy", ["broadcast", "sort_merge"])
+def test_pipeline_end_to_end_bit_identity(pq_path, build_frame, spill,
+                                          monkeypatch, strategy):
+    if strategy == "sort_merge":
+        monkeypatch.setenv("TFS_SPILL_DIR", spill.root)
+    ref = _pipeline_reference(pq_path, build_frame)
+    c0 = obs.counters()
+    out = relational.run_stream_pipeline(
+        {"parquet": pq_path, "window_rows": WINDOW},
+        stages=[
+            {"op": "map_rows", "graph": lambda x: {"y": x * 2.0},
+             "fetches": ["y"]},
+            {"op": "join", "on": "k", "build_frame": build_frame,
+             "strategy": strategy, "partitions": 4},
+            {"op": "aggregate", "keys": ["k"],
+             "graph": lambda y_input, w_input: {
+                 "y": y_input.sum(0), "w": w_input.sum(0)
+             },
+             "fetches": ["y", "w"]},
+        ],
+    )
+    assert _agg_dict(out["frame"]) == _agg_dict(ref)
+    # per-window attribution sums to the run's global counters delta
+    delta = obs.counters_delta(c0)
+    summed = {}
+    for snap in out["windows"]:
+        for key, n in snap["counters"].items():
+            summed[key] = summed.get(key, 0) + n
+    for key, n in summed.items():
+        if key in delta:
+            assert delta[key] == n, key
+
+
+def test_pipeline_chaos_bit_identity(pq_path, build_frame, monkeypatch):
+    ref = _pipeline_reference(pq_path, build_frame)
+    monkeypatch.setenv("TFS_BLOCK_RETRIES", "2")
+    monkeypatch.setenv("TFS_FAULT_INJECT", "transient:block=0:attempt=0")
+    before = obs.counters()["faults_injected"]
+    out = relational.run_stream_pipeline(
+        {"parquet": pq_path, "window_rows": WINDOW},
+        stages=[
+            {"op": "map_rows", "graph": lambda x: {"y": x * 2.0},
+             "fetches": ["y"]},
+            {"op": "join", "on": "k", "build_frame": build_frame},
+            {"op": "aggregate", "keys": ["k"],
+             "graph": lambda y_input, w_input: {
+                 "y": y_input.sum(0), "w": w_input.sum(0)
+             },
+             "fetches": ["y", "w"]},
+        ],
+    )
+    assert obs.counters()["faults_injected"] > before
+    assert _agg_dict(out["frame"]) == _agg_dict(ref)
+
+
+def test_pipeline_precheck_refuses_with_code(pq_path, build_frame):
+    with pytest.raises(ValidationError) as ei:
+        relational.run_stream_pipeline(
+            {"parquet": pq_path},
+            stages=[{"op": "join", "on": "zz",
+                     "build_frame": build_frame}],
+        )
+    assert ei.value.code == "TFS140"
+    # a map stage that drops the key is caught statically too
+    with pytest.raises(ValidationError) as ei:
+        relational.run_stream_pipeline(
+            {"parquet": pq_path},
+            stages=[
+                {"op": "map_rows", "graph": lambda x: {"y": x * 2.0},
+                 "fetches": ["y"], "trim": True},
+                {"op": "join", "on": "k", "build_frame": build_frame},
+            ],
+        )
+    assert ei.value.code == "TFS140"
+
+
+def test_pipeline_cancel_leaves_parquet_sink_at_window_boundary(
+    pq_path, tmp_path
+):
+    scope = cancellation.CancelScope(label="pipe-test")
+    seen = {"n": 0}
+
+    def cancelling_windows():
+        for wf in _scan(pq_path).windows():
+            seen["n"] += 1
+            if seen["n"] == 3:
+                scope.cancel("test cancel")
+            yield wf
+
+    class _FakeStream(streaming.StreamFrame):
+        def __init__(self):
+            super().__init__(
+                source=lambda: iter(()), window_rows=WINDOW,
+                reiterable=True, label="cancelling",
+            )
+
+        def windows(self):
+            return cancelling_windows()
+
+    sink_path = str(tmp_path / "out.parquet")
+    with cancellation.activate(scope):
+        with pytest.raises(cancellation.Cancelled):
+            relational.run_stream_pipeline(
+                _FakeStream(),
+                stages=[{"op": "map_rows",
+                         "graph": lambda x: {"y": x + 1.0},
+                         "fetches": ["y"]}],
+                sink={"kind": "parquet", "path": sink_path},
+            )
+    # the sink finalised over exactly the complete windows written
+    written = pq.read_table(sink_path)
+    assert written.num_rows in (2 * WINDOW, 3 * WINDOW)
+    assert written.num_rows % WINDOW == 0
+
+
+# ---------------------------------------------------------------------------
+# bridge pipelines
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def bridge(tmp_path, monkeypatch):
+    from tensorframes_tpu.bridge import BridgeClient, serve
+
+    # path-based pipeline sources/sinks are allowlisted per operator
+    # (TFS_BRIDGE_PIPELINE_PATHS); this test dir is the allowed root
+    monkeypatch.setenv("TFS_BRIDGE_PIPELINE_PATHS", str(tmp_path))
+    s = serve()
+    c = BridgeClient(*s.address, tenant="rel-t")
+    yield c
+    c.close()
+    s.close(drain_s=1.0)
+
+
+def _map_graph():
+    from tensorframes_tpu.graphdef.builder import GraphBuilder
+
+    g = GraphBuilder()
+    g.placeholder("x", "float64", [-1, 4])
+    g.const("two", np.float64(2.0))
+    g.op("Mul", "y", ["x", "two"])
+    return g.to_bytes()
+
+
+def _agg_graph():
+    from tensorframes_tpu.graphdef.builder import GraphBuilder
+
+    g = GraphBuilder()
+    g.placeholder("y_input", "float64", [-1, 4])
+    g.placeholder("w_input", "float64", [-1])
+    g.const("axis", np.int32(0))
+    g.op("Sum", "y", ["y_input", "axis"])
+    g.op("Sum", "w", ["w_input", "axis"])
+    return g.to_bytes()
+
+
+def test_bridge_pipeline_end_to_end_with_attribution(
+    pq_path, build_frame, bridge
+):
+    build = bridge.create_frame(
+        {
+            "k": np.asarray(build_frame.column("k").data),
+            "w": np.asarray(build_frame.column("w").data),
+        }
+    ).analyze()
+    r = bridge.run_pipeline(
+        {"parquet": pq_path, "window_rows": WINDOW},
+        stages=[
+            {"op": "map_rows", "graph": _map_graph(), "fetches": ["y"]},
+            {"op": "join", "on": "k", "build_frame_id": build.frame_id},
+            {"op": "aggregate", "keys": ["k"], "graph": _agg_graph(),
+             "fetches": ["y", "w"]},
+        ],
+    )
+    assert r["rows"] == N_ROWS
+    assert r["window_count"] == (N_ROWS + WINDOW - 1) // WINDOW
+    cid = bridge.last_correlation_id
+    # reference result
+    full = tfs.TensorFrame.from_parquet(pq_path)
+    mapped = tfs.map_rows(lambda x: {"y": x * 2.0}, full)
+    joined = relational.join_frames(mapped, build_frame, "k")
+    ref = tfs.aggregate(
+        lambda y_input, w_input: {
+            "y": y_input.sum(0), "w": w_input.sum(0)
+        },
+        tfs.group_by(joined, "k"),
+    )
+    cols = r["frame"].collect()
+    got = {
+        int(k): (np.asarray(y).tobytes(), float(w))
+        for k, y, w in zip(
+            np.asarray(cols["k"]), cols["y"], np.asarray(cols["w"])
+        )
+    }
+    assert got == _agg_dict(ref)
+    # per-window ledgers carry the request's cid and sum to its ledger
+    assert all(
+        w["correlation_id"].startswith(cid + ":w") for w in r["windows"]
+    )
+    led = bridge.attribution(cid)["ledger"]
+    assert led is not None
+    summed = {}
+    for w in r["windows"]:
+        for key, n in w["counters"].items():
+            summed[key] = summed.get(key, 0) + n
+    for key, n in summed.items():
+        assert led["counters"].get(key, 0) == n, key
+    extra = {
+        key for key, n in led["counters"].items()
+        if n and not summed.get(key)
+    }
+    # only request-scoped bookkeeping lives outside the windows
+    assert extra <= {"bridge_verbs_executed"}, extra
+
+
+def test_bridge_pipeline_deadline(pq_path, build_frame, bridge):
+    from tensorframes_tpu.bridge.client import DeadlineExceeded
+
+    build = bridge.create_frame(
+        {"k": np.arange(KEYS, dtype=np.int64),
+         "w": np.arange(KEYS, dtype=np.float64)}
+    ).analyze()
+    with pytest.raises(DeadlineExceeded):
+        bridge.run_pipeline(
+            {"parquet": pq_path, "window_rows": 50},
+            stages=[
+                {"op": "map_rows", "graph": _map_graph(),
+                 "fetches": ["y"]},
+                {"op": "join", "on": "k",
+                 "build_frame_id": build.frame_id},
+            ],
+            sink={"kind": "collect"},
+            deadline_ms=1,
+        )
+    # the session survives: the build frame is still usable
+    assert bridge.call("schema", frame_id=build.frame_id)["schema"]
+
+
+def test_bridge_pipeline_contract_refusal(pq_path, build_frame, bridge):
+    from tensorframes_tpu.bridge.client import BridgeError
+
+    build = bridge.create_frame(
+        {"k": np.arange(KEYS, dtype=np.int64)}
+    ).analyze()
+    with pytest.raises(BridgeError) as ei:
+        bridge.run_pipeline(
+            {"parquet": pq_path},
+            stages=[{"op": "join", "on": "zz",
+                     "build_frame_id": build.frame_id}],
+        )
+    assert ei.value.code == "TFS140"  # the TFSxxx code rides the wire
+
+
+def test_bridge_pipeline_path_outside_allowlist_refused(
+    pq_path, build_frame, bridge, monkeypatch, tmp_path
+):
+    from tensorframes_tpu.bridge.client import BridgeError
+
+    # an allowed source with a sink OUTSIDE the allowlisted root
+    with pytest.raises(BridgeError) as ei:
+        bridge.run_pipeline(
+            {"parquet": pq_path},
+            stages=[],
+            sink={"kind": "parquet", "path": "/etc/tfs-evil.parquet"},
+        )
+    assert "TFS_BRIDGE_PIPELINE_PATHS" in str(ei.value)
+    # no allowlist at all: even a readable path is refused
+    monkeypatch.setenv("TFS_BRIDGE_PIPELINE_PATHS", "")
+    with pytest.raises(BridgeError):
+        bridge.run_pipeline({"parquet": pq_path}, stages=[])
+    # frame_id sources need no filesystem access and always work
+    monkeypatch.setenv("TFS_BRIDGE_PIPELINE_PATHS", str(tmp_path))
+    f = bridge.create_frame(
+        {"k": np.arange(4, dtype=np.int64)}
+    ).analyze()
+    r = bridge.run_pipeline(
+        {"frame_id": f.frame_id, "window_rows": 2}, stages=[]
+    )
+    assert r["rows"] == 4
+
+
+def test_bridge_check_relational(bridge):
+    left = bridge.create_frame(
+        {"k": np.arange(4, dtype=np.int64), "v": np.arange(4.0)}
+    ).analyze()
+    right = bridge.create_frame(
+        {"k": np.arange(4, dtype=np.int64), "w": np.arange(4.0)}
+    ).analyze()
+    assert left.check("join", keys=["k"], right=right) == []
+    d = left.check("join", keys=["v"], right=right)
+    assert d and d[0]["code"] == "TFS140"
+    d = left.check("shuffle", keys=["k"])
+    assert d == []
+
+
+# ---------------------------------------------------------------------------
+# windowed-frame host-column release (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _windowed_cached_frame(tmp_path, monkeypatch):
+    from tensorframes_tpu.streaming.reader import frame_host_bytes
+
+    monkeypatch.setenv("TFS_SPILL_DIR", str(tmp_path / "spill"))
+    monkeypatch.setenv("TFS_CACHE_SHARDED", "always")
+    x = np.arange(2048, dtype=np.float32).reshape(256, 8)
+    f = tfs.TensorFrame.from_arrays({"x": x}, num_blocks=4)
+    f._host_windowed = True
+    return f, x, frame_host_bytes
+
+
+def test_windowed_cache_releases_host_columns(
+    tmp_path, monkeypatch, devices
+):
+    f, x, frame_host_bytes = _windowed_cached_frame(tmp_path, monkeypatch)
+    fc = f.cache(sharded=True)
+    assert frame_host_bytes(fc) == 0  # the host copy no longer pins RAM
+    # verbs stay bit-identical through the shard / spill stand-ins
+    out = tfs.map_blocks(lambda x: {"z": x * 2.0}, fc)
+    np.testing.assert_array_equal(
+        np.asarray(out.column("z").data), x * 2.0
+    )
+    r = tfs.reduce_blocks(lambda x_input: {"x": x_input.sum(0)}, fc)
+    np.testing.assert_allclose(np.asarray(r["x"]), x.sum(0))
+    # epochs over the released frame stay zero-H2D once shards are hot
+    c0 = obs.counters()
+    tfs.map_blocks(lambda x: {"z": x * 2.0}, fc)
+    assert obs.counters_delta(c0)["h2d_bytes_staged"] == 0
+    # uncache re-materialises real host arrays before the spill goes
+    back = fc.uncache()
+    data = back.column("x").data
+    assert isinstance(data, np.ndarray)
+    np.testing.assert_array_equal(data, x)
+
+
+def test_release_under_budget_evictions(tmp_path, monkeypatch, devices):
+    """Released columns survive LRU churn: every block has a durable
+    home (HBM shard or spill file) at all times."""
+    f, x, frame_host_bytes = _windowed_cached_frame(tmp_path, monkeypatch)
+    monkeypatch.setenv("TFS_HBM_BUDGET", "5K")  # ~2 of 4 shards fit
+    fc = f.cache(sharded=True)
+    assert frame_host_bytes(fc) == 0
+    cache = fc._cache
+    assert cache.resident_blocks() < 4
+    out = tfs.map_blocks(lambda x: {"z": x + 1.0}, fc)
+    np.testing.assert_array_equal(
+        np.asarray(out.column("z").data), x + 1.0
+    )
+    # full host re-materialisation from mixed shard/spill state
+    np.testing.assert_array_equal(
+        np.asarray(fc.column("x").data), x
+    )
+
+
+def test_shuffle_on_released_frame(tmp_path, monkeypatch, devices):
+    """A released windowed frame stays fully usable by the relational
+    verbs: shuffling it matches shuffling the original bit for bit."""
+    monkeypatch.setenv("TFS_SPILL_DIR", str(tmp_path / "spill"))
+    monkeypatch.setenv("TFS_CACHE_SHARDED", "always")
+    from tensorframes_tpu.streaming.reader import frame_host_bytes
+
+    rng = np.random.RandomState(8)
+    k = rng.randint(0, 5, 64).astype(np.int32)
+    x = np.arange(256, dtype=np.float32).reshape(64, 4)
+    f = tfs.TensorFrame.from_arrays({"k": k, "x": x}, num_blocks=4)
+    f._host_windowed = True
+    fc = f.cache(sharded=True)
+    assert frame_host_bytes(fc) == 0  # columns really are released
+    sh = relational.shuffle(
+        fc, "k", partitions=3, spill=SpillStore(str(tmp_path / "s1"))
+    )
+    ref = relational.shuffle(
+        tfs.TensorFrame.from_arrays({"k": k, "x": x}, num_blocks=4),
+        "k", partitions=3, spill=SpillStore(str(tmp_path / "s2")),
+    )
+    assert _rows(_concat_windows(sh.stream())) == _rows(
+        _concat_windows(ref.stream())
+    )
+
+
+def test_release_host_knob_off(tmp_path, monkeypatch, devices):
+    monkeypatch.setenv("TFS_RELEASE_HOST", "0")
+    f, x, frame_host_bytes = _windowed_cached_frame(tmp_path, monkeypatch)
+    fc = f.cache(sharded=True)
+    assert frame_host_bytes(fc) > 0  # pre-round-18 pinning preserved
+    assert isinstance(fc.column("x").data, np.ndarray)

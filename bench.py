@@ -2518,13 +2518,16 @@ def _planner_measure() -> dict:
     os.environ["TFS_DEVICE_POOL"] = "auto"
     os.environ["TFS_PREFETCH_BLOCKS"] = "2"
 
+    # the trimmed projection consumes b FIRST (materialising it), so the
+    # terminal reduce reads the memoized/adopted intermediate — config
+    # 17 stays the round-14 twice-consumed-intermediate story; the
+    # round-19 fused terminal reduce (reduce-only chains) is config 21
     def eager_epoch(frame):
         a = tfs.map_blocks(m1, frame, engine=eager_engine)
         b = tfs.map_blocks(m2, a, engine=eager_engine)
-        r = tfs.reduce_blocks(red, b, engine=eager_engine)
         o = tfs.map_blocks(m3, b, trim=True, engine=eager_engine)
         np.asarray(o.column("s").data)
-        return r
+        return tfs.reduce_blocks(red, b, engine=eager_engine)
 
     decisions = []
 
@@ -2532,9 +2535,9 @@ def _planner_measure() -> dict:
         lz = frame.lazy()
         a = tfs.map_blocks(m1, lz)
         b = tfs.map_blocks(m2, a)
-        r = tfs.reduce_blocks(red, b)
         o = tfs.map_blocks(m3, b, trim=True)
         np.asarray(o.column("s").data)
+        r = tfs.reduce_blocks(red, b)
         decisions[:] = list(b._last_records) + list(o._last_records)
         return r
 
@@ -2684,6 +2687,251 @@ def bench_planner(jax, tfs) -> None:
                 f"{m.get('planned_rerun_program_traces')} re-run traces; "
                 "bit_identical pins planned == eager bytes on the "
                 "reduce results"
+            ),
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# config #21: planner v2 — fused terminal reduce vs eager materialize-
+# then-reduce, cross-plan CSE with exact ledger shares, planned
+# multi-epoch iterate (round 19)
+# ---------------------------------------------------------------------------
+
+
+def _planner_v2_measure() -> dict:
+    """Config 21 legs, on a multi-device host (parent or the forced
+    8-host-device CPU child, ``TFS_BENCH_PLAN2_CHILD``)."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import observability as obs
+
+    n_dev = len(jax.local_devices())
+    n, d, nb, reps = 16384, 64, 8, 8
+    rng = np.random.RandomState(0)
+    data = {"x": rng.rand(n, d).astype(np.float32)}
+    w1 = ((rng.rand(d, d) - 0.5) / d).astype(np.float32)
+    w2 = ((rng.rand(d, d) - 0.5) / d).astype(np.float32)
+    m1 = tfs.Program.wrap(lambda x: {"y": jnp.tanh(x @ w1)}, fetches=["y"])
+    m2 = tfs.Program.wrap(lambda y: {"z": jnp.tanh(y @ w2)}, fetches=["z"])
+    red = tfs.Program.wrap(
+        lambda z_input: {"z": (z_input * 1.3).sum(0)}, fetches=["z"]
+    )
+    eager_engine = tfs.Executor()
+
+    old = {
+        k: os.environ.get(k)
+        for k in (
+            "TFS_DEVICE_POOL",
+            "TFS_PREFETCH_BLOCKS",
+            "TFS_PLAN",
+            "TFS_PLAN_POOL_MIN_INTENSITY",
+        )
+    }
+    os.environ["TFS_DEVICE_POOL"] = "auto"
+    os.environ["TFS_PREFETCH_BLOCKS"] = "2"
+    os.environ["TFS_PLAN_POOL_MIN_INTENSITY"] = "0"
+
+    def eager_epoch(frame):
+        a = tfs.map_blocks(m1, frame, engine=eager_engine)
+        b = tfs.map_blocks(m2, a, engine=eager_engine)
+        return tfs.reduce_blocks(red, b, engine=eager_engine)
+
+    def planned_epoch(frame):
+        # fresh chain each epoch: the terminal reduce fuses into the
+        # chain dispatch (no materialized intermediate at all)
+        b = tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy()))
+        return tfs.reduce_blocks(red, b)
+
+    def epoch_stats(epoch, frame):
+        c0 = obs.counters()
+        t0 = time.perf_counter()
+        r = epoch(frame)
+        dt = time.perf_counter() - t0
+        return dt, obs.counters_delta(c0), r
+
+    try:
+        # ---- leg (a): map->reduce chain, fused vs materialize-then-
+        # reduce (interleaved best-of like config 17) -----------------
+        eager_frame = tfs.TensorFrame.from_arrays(data, num_blocks=nb)
+        planned_frame = tfs.TensorFrame.from_arrays(data, num_blocks=nb)
+        epoch_stats(eager_epoch, eager_frame)  # compile
+        epoch_stats(planned_epoch, planned_frame)
+        epoch_stats(eager_epoch, eager_frame)  # settle (cache promote)
+        epoch_stats(planned_epoch, planned_frame)
+        e_best = p_best = float("inf")
+        e_stats = p_stats = None
+        e_r = p_r = None
+        for _ in range(reps):
+            dt, delta, e_r = epoch_stats(eager_epoch, eager_frame)
+            e_best, e_stats = min(e_best, dt), delta
+            dt, delta, p_r = epoch_stats(planned_epoch, planned_frame)
+            p_best, p_stats = min(p_best, dt), delta
+
+        # ---- leg (b): two concurrent requests share one subplan -----
+        cse_frame = tfs.TensorFrame.from_arrays(
+            {"x": rng.rand(n, d).astype(np.float32)}, num_blocks=nb
+        )
+        snaps = [None, None]
+        barrier = threading.Barrier(2)
+
+        def worker(i):
+            with obs.request_ledger(
+                tenant=f"tenant{i}", method="verb"
+            ) as led:
+                barrier.wait()
+                lz = tfs.map_blocks(m2, tfs.map_blocks(m1, cse_frame.lazy()))
+                np.asarray(lz.column("z").data)
+            snaps[i] = led.snapshot()
+
+        c0 = obs.counters()
+        ts = [
+            threading.Thread(target=worker, args=(i,)) for i in range(2)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        cse_delta = obs.counters_delta(c0)
+        sums = {}
+        for s in snaps:
+            for k, v in s["counters"].items():
+                sums[k] = sums.get(k, 0) + v
+        ledger_exact = all(
+            sums.get(k, 0) == v for k, v in cse_delta.items() if v
+        )
+
+        # ---- leg (c): planned multi-epoch iterate -------------------
+        it_frame = tfs.TensorFrame.from_arrays(
+            {"x": rng.rand(n, d).astype(np.float32)}, num_blocks=nb
+        )
+        epoch_deltas = []
+
+        def it_step(root, e):
+            c0 = obs.counters()
+            b = tfs.map_blocks(m2, tfs.map_blocks(m1, root))
+            r = tfs.reduce_blocks(red, b)
+            epoch_deltas.append(obs.counters_delta(c0))
+            return r
+
+        it_rs = tfs.iterate_epochs(it_frame, it_step, 4)
+        steady = epoch_deltas[1:]
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    return {
+        "value": round(n / p_best, 1),
+        "devices": n_dev,
+        # (a) fused terminal reduce
+        "planned_rows_s": round(n / p_best, 1),
+        "eager_rows_s": round(n / e_best, 1),
+        "eager_epoch_h2d_bytes": e_stats["h2d_bytes_staged"],
+        "planned_epoch_h2d_bytes": p_stats["h2d_bytes_staged"],
+        "eager_epoch_d2h_bytes": e_stats["d2h_bytes_assembled"],
+        "planned_epoch_d2h_bytes": p_stats["d2h_bytes_assembled"],
+        "planned_fused_reduces": p_stats["plan_fused_reduces"],
+        "bit_identical": bool(np.array_equal(e_r["z"], p_r["z"])),
+        # (b) cross-plan CSE
+        "cse_hits": cse_delta["plan_cse_hits"],
+        "cse_ledger_sums_exact": bool(ledger_exact),
+        "cse_h2d_bytes": cse_delta["h2d_bytes_staged"],
+        # (c) planned multi-epoch iterate
+        "iterate_epochs": len(epoch_deltas),
+        "iterate_steady_h2d_bytes": max(
+            s["h2d_bytes_staged"] for s in steady
+        ),
+        "iterate_steady_traces": max(
+            s["program_traces"] for s in steady
+        ),
+        "iterate_bit_stable": bool(
+            all(np.array_equal(it_rs[0]["z"], r["z"]) for r in it_rs)
+        ),
+        "workload": (
+            f"map->map->reduce (tanh {d}x{d} matmuls) over {n}x{d} f32, "
+            f"{nb} blocks; 2 concurrent CSE requests; 4 planned epochs"
+        ),
+    }
+
+
+def bench_planner_v2(jax, tfs) -> None:
+    """Config 21 (round 19): planner v2 — (a) fused terminal reduce vs
+    eager materialize-then-reduce with the intermediate's D2H/H2D bytes
+    eliminated (counter evidence), bit-identical; (b) two concurrent
+    requests sharing a subplan execute it once with per-request ledgers
+    summing to the global delta; (c) planned multi-epoch iterate at 0
+    steady-state H2D and 0 re-run traces."""
+    import subprocess
+    import sys
+
+    if len(jax.local_devices()) >= 2:
+        m = _planner_v2_measure()
+        m["forced_host_devices"] = False
+    else:
+        env = dict(os.environ)
+        env["TFS_BENCH_PLAN2_CHILD"] = "1"
+        env["TFS_BENCH_KEEP_STDERR"] = "1"  # parent owns bench_stderr.log
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        for k in ("TFS_DEVICE_POOL", "TFS_PREFETCH_BLOCKS", "TFS_PLAN"):
+            env.pop(k, None)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        if proc.returncode != 0 or not proc.stdout.strip():
+            raise RuntimeError(
+                f"planner-v2 child failed (rc={proc.returncode}): "
+                f"{(proc.stderr or proc.stdout)[-400:]}"
+            )
+        m = json.loads(proc.stdout.strip().splitlines()[-1])
+        m["forced_host_devices"] = True
+
+    value = m.pop("value")
+    eager = m.get("eager_rows_s")
+    _emit(
+        {
+            "metric": (
+                f"planned map->reduce, fused terminal fold "
+                f"({m.get('devices')} devices)"
+            ),
+            "value": value,
+            "unit": "rows/sec",
+            "vs_baseline": round(value / eager, 3) if eager else None,
+            "baseline": (
+                f"same chain, eager materialize-then-reduce "
+                f"({eager} rows/s)"
+            ),
+            "config": 21,
+            **m,
+            "note": (
+                "leg a: the terminal reduce folds inside the pooled "
+                "chain dispatch — the intermediate frame's "
+                f"{m.get('eager_epoch_d2h_bytes')} D2H + "
+                f"{m.get('eager_epoch_h2d_bytes')} H2D bytes/epoch drop "
+                f"to {m.get('planned_epoch_d2h_bytes')} / "
+                f"{m.get('planned_epoch_h2d_bytes')}, bit-identical; "
+                "leg b: two concurrent identical chains executed once "
+                f"(plan_cse_hits={m.get('cse_hits')}) with per-request "
+                "ledger shares summing to the global delta "
+                f"(exact={m.get('cse_ledger_sums_exact')}); leg c: "
+                "planned iterate_epochs steady state stages "
+                f"{m.get('iterate_steady_h2d_bytes')} H2D bytes and "
+                f"re-traces {m.get('iterate_steady_traces')} programs"
             ),
         }
     )
@@ -3298,6 +3546,12 @@ def main() -> None:
         print(json.dumps(_planner_measure()), flush=True)
         return
 
+    # config-21 child mode: forced multi-device topology, planner-v2
+    # fused-terminal-reduce / CSE / planned-iterate legs
+    if os.environ.get("TFS_BENCH_PLAN2_CHILD") == "1":
+        print(json.dumps(_planner_v2_measure()), flush=True)
+        return
+
     # config-19 child mode: forced multi-device topology, coalesced
     # multi-tenant serving legs
     if os.environ.get("TFS_BENCH_SERVE_CHILD") == "1":
@@ -3342,6 +3596,7 @@ def main() -> None:
         bench_stream_frames,
         bench_observability,
         bench_planner,
+        bench_planner_v2,
         bench_attribution,
         bench_relational_pipeline,
         bench_lm_train,

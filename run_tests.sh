@@ -158,7 +158,23 @@ echo "== planner tier (lazy verb-graph planner, TFS_PLAN=1 live) =="
 TFS_PLAN=1 \
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 JAX_PLATFORMS=cpu \
-  python -m pytest tests/test_planner.py -q
+  python -m pytest tests/test_planner.py tests/test_planner_v2.py -q
+
+# Planner-v2 streaming+relational leg (round 19): the out-of-core
+# streaming and relational-pipeline suites re-run with TFS_PLAN=1 so
+# every windowed map chain routes through per-window plan construction
+# (fusion + pruning + bucket pads), under TFS_ANALYZE_XCHECK=1 so each
+# plan's row-independence pads stay fenced by the differential
+# soundness oracle — the planned window path must be bit-identical to
+# the eager per-stage path these files pin.
+echo "== planner-v2 streaming+relational leg (TFS_PLAN=1 + analyze xcheck) =="
+TFS_PLAN2_TMP="$(mktemp -d)"
+TFS_PLAN=1 TFS_ANALYZE_XCHECK=1 \
+TFS_SPILL_DIR="$TFS_PLAN2_TMP" TFS_STREAM_WINDOW=256 \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_stream_frames.py tests/test_relational.py -q
+rm -rf "$TFS_PLAN2_TMP"
 
 echo "== pytest =="
 exec python -m pytest tests/ -q --ignore=tests/test_device_pool.py \

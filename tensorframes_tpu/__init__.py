@@ -31,15 +31,18 @@ from .frame import TensorFrame
 from .ops import (
     Executor,
     LazyFrame,
+    LazyGroupedFrame,
     Pipeline,
     ValidationError,
     aggregate,
     group_by,
+    iterate_epochs,
     map_blocks,
     map_rows,
     pipeline,
     reduce_blocks,
     reduce_rows,
+    warm_plan,
     warmup,
 )
 from .program import (
@@ -103,9 +106,12 @@ __all__ = [
     "UNKNOWN",
     "Executor",
     "LazyFrame",
+    "LazyGroupedFrame",
     "ValidationError",
     "aggregate",
     "group_by",
+    "iterate_epochs",
+    "warm_plan",
     "map_blocks",
     "map_blocks_trimmed",
     "map_rows",

@@ -110,27 +110,10 @@ SLO_PRESSURE_FRACTION = 0.8
 _SLO_SNAPSHOT_TTL_S = 0.5
 
 
-def _apportion(total: int, weights: Sequence[int]) -> List[int]:
-    """Split integer ``total`` proportionally to ``weights`` so the
-    shares sum to ``total`` EXACTLY (largest-remainder method, ties to
-    the earliest index — deterministic).  The bit-for-bit contract of
-    coalesced ledger attribution hangs on this."""
-    w = sum(weights)
-    if w <= 0 or total == 0:
-        out = [0] * len(weights)
-        if weights and total:
-            out[0] = total
-        return out
-    base = [total * wi // w for wi in weights]
-    rem = total - sum(base)
-    # fractional parts, largest first; index breaks ties deterministically
-    order = sorted(
-        range(len(weights)),
-        key=lambda i: (-(total * weights[i] % w), i),
-    )
-    for i in order[:rem]:
-        base[i] += 1
-    return base
+# the ONE exact integer-split behind shared-work ledger attribution —
+# promoted to observability (round 19) so the planner's CSE registry and
+# this coalescer cannot drift apart; the name stays for callers/tests
+_apportion = observability.apportion
 
 
 # ---------------------------------------------------------------------------
@@ -668,7 +651,27 @@ class Coalescer:
 
     def _execute(self, program, verb, trim, frame) -> TensorFrame:
         """One solo dispatch through the ordinary engine path (shared by
-        the ineligible/solo branch and the proof-failed fallback)."""
+        the ineligible/solo branch and the proof-failed fallback).
+
+        Round 19: with ``TFS_PLAN`` live on the server, the dispatch
+        routes through the planner instead — concurrent requests on the
+        SAME registered frame with the same warm-pool Program then
+        rendezvous in the cross-plan CSE registry and execute the
+        subplan exactly once, each absorbing its exact ledger share
+        (``plan_cse_hits``); coalescing still owns the different-rows
+        case, CSE owns the identical-subplan case."""
+        if self.engine is None:
+            from ..ops import planner
+
+            if planner.planning_enabled() and isinstance(
+                frame, TensorFrame
+            ):
+                node = planner.root_for(frame)._append(
+                    "map_rows" if verb == "map_rows" else "map_blocks",
+                    program,
+                    trim=trim,
+                )
+                return node._materialize(count_use=False)
         ex = self._executor()
         if verb == "map_rows":
             return ex.map_rows(program, frame)

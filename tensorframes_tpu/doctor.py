@@ -53,6 +53,14 @@ Rules (each fires at most one diagnostic):
   partition's memory bound blows past total/partitions.  The advice
   names the key and ``TFS_SHUFFLE_PARTITIONS`` (evidence:
   ``relational.recent_shuffle_stats()``, injectable as ``shuffles=``).
+* **cse_miss** (round 19) — the SAME subplan keeps re-executing across
+  recent requests with no cross-plan sharing (evidence: the planner's
+  plan-signature registry).  Usually the result frame is dropped
+  between requests (no ``.lazy()`` retention / shared cache) or the
+  requests rebuild distinct Program objects for one graph (enable the
+  warm program pool so object identity holds).  Advise ``.lazy()`` +
+  ``TFS_PLAN_CSE`` so identical subplans execute once and share the
+  sharded-cached result.
 * **indep_probe_churn** (round 17) — row-independence questions keep
   falling back to the per-size compile probe instead of being answered
   by the static classifier (``analysis/rowdep.py``): every new bucket
@@ -441,6 +449,41 @@ def _rule_shuffle_skew(shuffles) -> Optional[Dict[str, Any]]:
     )
 
 
+def _rule_cse_miss(c, plans) -> Optional[Dict[str, Any]]:
+    """One subplan signature re-executed >= MIN_EVENTS times with zero
+    registry hits: the cross-plan sharing the planner offers is being
+    left on the table (result dropped between requests, CSE off, or
+    per-request Program rebuilds defeating object identity)."""
+    worst = None
+    for s in plans or ():
+        ex, hits = int(s.get("executions", 0)), int(s.get("hits", 0))
+        if ex < MIN_EVENTS or hits > 0:
+            continue
+        if worst is None or ex > worst[0]:
+            worst = (ex, int(s.get("stages", 0)))
+    if worst is None:
+        return None
+    ex, stages = worst
+    total_hits = c.get("plan_cse_hits", 0)
+    return _diag(
+        "cse_miss",
+        "info",
+        f"one {stages}-stage subplan executed {ex} times across recent "
+        f"requests with 0 cross-plan shares (process-wide "
+        f"plan_cse_hits={total_hits}) — identical work is being re-paid "
+        f"per request",
+        {"executions": ex, "stages": stages,
+         "plan_cse_hits": total_hits},
+        "TFS_PLAN_CSE",
+        "keep TFS_PLAN_CSE on and hold the shared subplan's result "
+        "alive (.lazy() retention or cache(sharded=True)) so repeats "
+        "reuse it; on the bridge, enable the warm program pool "
+        "(TFS_BRIDGE_WARM) so identical requests share one Program "
+        "object — the registry keys on object identity plus live "
+        "params",
+    )
+
+
 def _rule_indep_probe_churn(c) -> Optional[Dict[str, Any]]:
     falls = c.get("analysis_probe_fallbacks", 0)
     hits = c.get("analysis_static_hits", 0)
@@ -471,6 +514,7 @@ def doctor(
     spans: Optional[Sequence[Mapping[str, Any]]] = None,
     tenants: Optional[Mapping[str, Mapping[str, Any]]] = None,
     shuffles: Optional[Sequence[Mapping[str, Any]]] = None,
+    plans: Optional[Sequence[Mapping[str, Any]]] = None,
 ) -> List[Dict[str, Any]]:
     """Diagnose the process's (or the given snapshots') performance
     state.  Returns structured diagnostics, worst first — each names
@@ -501,6 +545,13 @@ def doctor(
             shuffles = recent_shuffle_stats()
         except Exception:  # noqa: BLE001 — diagnosis must never fail here
             shuffles = []
+    if plans is None:
+        try:
+            from .ops.planner import recent_plan_stats
+
+            plans = recent_plan_stats()
+        except Exception:  # noqa: BLE001 — diagnosis must never fail here
+            plans = []
     out: List[Dict[str, Any]] = []
     for rule in (
         lambda: _rule_shed_burn(c),
@@ -512,6 +563,7 @@ def doctor(
         lambda: _rule_unfair_tenant(c, tenants),
         lambda: _rule_coalesce_miss(c),
         lambda: _rule_shuffle_skew(shuffles),
+        lambda: _rule_cse_miss(c, plans),
         lambda: _rule_indep_probe_churn(c),
         lambda: _rule_slow_tail(lat),
     ):

@@ -145,6 +145,16 @@ _counters: Dict[str, int] = {
     "plan_fused_dispatches": 0,
     "plan_columns_pruned": 0,
     "plan_cache_inserts": 0,
+    # planner v2 (round 19): terminal reduce/aggregate folds fused into
+    # the chain dispatch (no materialized intermediate), identical
+    # subplans served from the cross-plan CSE registry instead of
+    # re-executing, streaming windows routed through plan construction,
+    # and the pooled readback volume (D2H bytes assembled to host) the
+    # fused terminals eliminate
+    "plan_fused_reduces": 0,
+    "plan_cse_hits": 0,
+    "plan_stream_windows": 0,
+    "d2h_bytes_assembled": 0,
     # multi-tenant serving throughput (round 16, bridge/coalescer.py):
     # micro-batches dispatched, requests they carried, requests that
     # dispatched ALONE on a hot program (the coalesce_miss evidence),
@@ -387,6 +397,32 @@ class RequestLedger:
                     for k, v in sorted(self.latency.items())
                 },
             }
+
+
+def apportion(total: int, weights: Sequence[int]) -> List[int]:
+    """Split integer ``total`` proportionally to ``weights`` so the
+    shares sum to ``total`` EXACTLY (largest-remainder method, ties to
+    the earliest index — deterministic).  The bit-for-bit contract of
+    shared-work ledger attribution hangs on this: the bridge coalescer
+    splits batch deltas by row share, and the planner's CSE registry
+    splits a deduplicated subplan's delta evenly across its consumers
+    (``RequestLedger.absorb`` on each side)."""
+    w = sum(weights)
+    if w <= 0 or total == 0:
+        out = [0] * len(weights)
+        if weights and total:
+            out[0] = total
+        return out
+    base = [total * wi // w for wi in weights]
+    rem = total - sum(base)
+    # fractional parts, largest first; index breaks ties deterministically
+    order = sorted(
+        range(len(weights)),
+        key=lambda i: (-(total * weights[i] % w), i),
+    )
+    for i in order[:rem]:
+        base[i] += 1
+    return base
 
 
 def current_request() -> Optional[RequestLedger]:
@@ -711,6 +747,35 @@ def note_plan_cache_insert() -> None:
     _bump("plan_cache_inserts")
 
 
+def note_plan_fused_reduce() -> None:
+    """One terminal ``reduce_rows``/``reduce_blocks``/``aggregate``
+    folded into the planned chain dispatch (``ops/planner.py`` round
+    19): per-block partials computed on the chain's devices, no
+    materialized intermediate frame."""
+    _bump("plan_fused_reduces")
+
+
+def note_plan_cse_hit() -> None:
+    """One planned subplan served from the cross-plan common-
+    subexpression registry instead of re-executing — concurrent waiters
+    and later identical chains both count."""
+    _bump("plan_cse_hits")
+
+
+def note_plan_stream_window() -> None:
+    """One streaming window executed through plan construction (fused
+    map chain + dead-column pruning) instead of per-stage eager
+    dispatch."""
+    _bump("plan_stream_windows")
+
+
+def note_d2h_bytes(n: int) -> None:
+    """``n`` device bytes assembled back to host by the pooled readback
+    window (``PoolRun._materialize``) — the D2H half of the round trip a
+    fused terminal reduce eliminates."""
+    _bump("d2h_bytes_assembled", int(n))
+
+
 def note_analysis_static_hit() -> None:
     """One row-independence question answered by the static classifier
     (``analysis/rowdep.py``) with NO per-size compile probe."""
@@ -890,6 +955,10 @@ def counters_delta(
             "plan_fused_dispatches",
             "plan_columns_pruned",
             "plan_cache_inserts",
+            "plan_fused_reduces",
+            "plan_cse_hits",
+            "plan_stream_windows",
+            "d2h_bytes_assembled",
             "coalesced_batches",
             "coalesced_requests",
             "coalesced_rows",

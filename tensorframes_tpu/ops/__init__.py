@@ -11,14 +11,17 @@ from .engine import (
     warmup,
 )
 from .pipeline import Pipeline, pipeline
-from .planner import LazyFrame
+from .planner import LazyFrame, LazyGroupedFrame, iterate_epochs, warm_plan
 from .validation import ValidationError
 
 __all__ = [
     "Executor",
     "aggregate",
     "group_by",
+    "iterate_epochs",
     "LazyFrame",
+    "LazyGroupedFrame",
+    "warm_plan",
     "map_blocks",
     "map_rows",
     "Pipeline",

@@ -358,6 +358,9 @@ class PoolRun:
         bi, outs = self._window[di].pop(0)
         t0 = time.perf_counter()
         out_blocks[bi] = {k: np.asarray(v) for k, v in outs.items()}
+        observability.note_d2h_bytes(
+            sum(int(v.nbytes) for v in out_blocks[bi].values())
+        )
         now = time.perf_counter()
         # flight recorder: the D2H materialisation is where a pooled
         # block actually syncs — its track placement shows per-device

@@ -2333,6 +2333,11 @@ class Executor:
         Groups are bucketed by cardinality and each bucket runs as ONE
         ``vmap``-ed device call over all its groups — the TPU-shaped
         replacement for Spark's shuffle + row-buffered UDAF."""
+        if type(grouped) is not GroupedFrame:
+            # a deferred LazyGroupedFrame handed straight to an engine
+            # instance: materialise and run the eager constructor's key
+            # checks (scalar rank, existence) that deferral skipped
+            grouped = GroupedFrame(grouped.frame, grouped.keys)
         with observability.verb_span(
             "aggregate", grouped.frame.num_rows, grouped.frame.num_blocks
         ) as span:
@@ -3002,10 +3007,22 @@ def aggregate(
     engine: Optional[Executor] = None,
 ) -> TensorFrame:
     """Keyed algebraic aggregation (``tfs.aggregate``,
-    reference ``core.py:319-336``).  Grouping a LazyFrame materialises
-    the plan (group structure is data-dependent); the aggregate itself
-    always runs eagerly over the materialised frame."""
+    reference ``core.py:319-336``).  Grouping a LazyFrame defers the
+    one materialisation it still needs (group structure is
+    data-dependent) to this call, which prunes the chain's fetches to
+    exactly the key + reduced columns (``ops/planner.py`` round 19);
+    the aggregate itself always runs the eager engine over the
+    materialised columns, so grouping numerics cannot drift."""
     program = _wrap(fn, fetches, shapes=shapes)
+    from . import planner
+
+    if isinstance(grouped, planner.LazyGroupedFrame):
+        if engine is None:
+            return grouped.lazy._aggregate_terminal(
+                program, grouped.keys, grouped=grouped
+            )
+        # explicit engine: materialise the full plan, validate keys
+        grouped = GroupedFrame(grouped.frame, grouped.keys)
     if getattr(grouped.frame, "_tfs_lazy", False):
         grouped = GroupedFrame(_lazy_frame(grouped.frame), grouped.keys)
     return _resolve(engine).aggregate(program, grouped)
@@ -3021,8 +3038,17 @@ def warmup(
     engine: Optional[Executor] = None,
 ) -> List[str]:
     """AOT-compile the map-verb executables ``fn`` will run over
-    ``frame`` (persistent-cache cold start; see ``Executor.warmup``)."""
+    ``frame`` (persistent-cache cold start; see ``Executor.warmup``).
+
+    A LazyFrame argument first primes the PLAN's own fused-chain grid
+    (``planner.warm_plan`` — the bucketed, donating, per-device entries
+    the optimizer dispatches, which per-stage warmups miss), then
+    materialises and warms ``fn`` over the result."""
     program = Program.wrap(fn, fetches, feed_dict)
+    if engine is None and getattr(frame, "_tfs_lazy", False):
+        from . import planner
+
+        planner.warm_plan(frame)
     frame = _lazy_frame(frame)
     return _resolve(engine).warmup(
         program, frame, rows_level=rows_level, host_stage=host_stage

@@ -74,9 +74,47 @@ logger = logging.getLogger("tensorframes_tpu.frame_cache")
 
 ENV_SHARDED = "TFS_CACHE_SHARDED"
 ENV_BUDGET = "TFS_HBM_BUDGET"
+ENV_TENANT_BUDGET = "TFS_CACHE_TENANT_BUDGET"
 
 def _warn_once(key: str, msg: str, *args) -> None:
     warn_once(logger, "frame_cache:" + key, msg, *args)
+
+
+def tenant_budget() -> int:
+    """Per-tenant resident-shard byte budget
+    (``TFS_CACHE_TENANT_BUDGET``; 0 = no per-tenant cap, round 19).
+
+    Layered UNDER ``TFS_HBM_BUDGET``: a tenant whose resident shards
+    would exceed this cap evicts its OWN least-recently-used shards
+    first, so one tenant's epoch loop cannot flush every other
+    tenant's warm shards out of the shared LRU.  Tenant identity is
+    billed from real PR 10 ledger usage: the request ledger active when
+    a cache is built/adopted names the owning tenant."""
+    raw = envutil.env_raw(ENV_TENANT_BUDGET)
+    if not raw.strip():
+        return 0
+    parsed = parse_bytes(raw)
+    if parsed is None:
+        _warn_once(
+            "tenant_budget:" + raw,
+            "%s=%r is malformed; use bytes or a K/M/G suffix. "
+            "Treating as no per-tenant cap.",
+            ENV_TENANT_BUDGET,
+            raw,
+        )
+        return 0
+    return parsed
+
+
+def _request_tenant() -> Optional[str]:
+    """The tenant the active request chain attributes work to (nested
+    ledgers may leave ``tenant`` on an outer ledger only)."""
+    led = observability.current_request()
+    while led is not None:
+        if led.tenant:
+            return led.tenant
+        led = led.parent
+    return None
 
 
 def hbm_budget() -> int:
@@ -200,6 +238,10 @@ class FrameCache:
         self.nbytes: List[int] = [0] * len(self.assignment)
         self.adopted = adopted
         self.spill = spill
+        # per-tenant budget attribution (round 19): the request ledger
+        # active at build/adopt time names the owner; None bills to the
+        # shared (un-tenanted) pool, which has no per-tenant cap
+        self.tenant: Optional[str] = _request_tenant()
         self._spilled: set = set()
         self._spill_tag = f"shard-{os.getpid()}-{id(self):x}"
         if spill is not None:
@@ -357,9 +399,16 @@ class _HbmBudget:
 
     def __init__(self):
         self._lock = threading.Lock()
-        # key: (id(cache), bi) -> (weakref(cache), bi, nbytes)
+        # key: (id(cache), bi) -> (weakref(cache), bi, nbytes, tenant)
         self._entries: "collections.OrderedDict" = collections.OrderedDict()
         self.total_bytes = 0
+        # per-tenant resident bytes (round 19, TFS_CACHE_TENANT_BUDGET)
+        self.tenant_bytes: Dict[str, int] = {}
+        # per-tenant LRU key index (ordered set mirroring _entries'
+        # recency for that tenant's shards): the self-first eviction's
+        # victim lookup is O(1) instead of a scan of every tenant's
+        # entries under the global lock
+        self.tenant_keys: Dict[str, "collections.OrderedDict"] = {}
 
     def _drop(self, key) -> Optional[tuple]:
         """Unaccount one entry (lock held); returns ``(cache, bi)``
@@ -367,8 +416,19 @@ class _HbmBudget:
         for dead/refunded entries.  The hook runs OUTSIDE the lock —
         spill-backed eviction does disk I/O (``FrameCache.evict``), and
         a process-wide lock must never wait on a disk write."""
-        ref, bi, nbytes = self._entries.pop(key)
+        ref, bi, nbytes, tenant = self._entries.pop(key)
         self.total_bytes -= nbytes
+        if tenant is not None:
+            left = self.tenant_bytes.get(tenant, 0) - nbytes
+            if left > 0:
+                self.tenant_bytes[tenant] = left
+            else:
+                self.tenant_bytes.pop(tenant, None)
+            keys = self.tenant_keys.get(tenant)
+            if keys is not None:
+                keys.pop(key, None)
+                if not keys:
+                    self.tenant_keys.pop(tenant, None)
         cache = ref()
         return (cache, bi) if cache is not None else None
 
@@ -381,6 +441,8 @@ class _HbmBudget:
 
     def charge(self, cache: FrameCache, bi: int, nbytes: int) -> bool:
         budget = hbm_budget()
+        t_budget = tenant_budget()
+        tenant = getattr(cache, "tenant", None)
         evictions = []
         with self._lock:
             self._prune()
@@ -391,14 +453,35 @@ class _HbmBudget:
                 # refusal, not eviction: the shard was never resident,
                 # so the eviction counter (LRU churn evidence) stays put
                 return False
+            if tenant is not None and t_budget:
+                if nbytes > t_budget:
+                    return False  # one shard over the whole tenant cap
+                # over-budget tenants evict their OWN LRU shards first
+                # (round 19): other tenants' warm shards stay resident
+                while (
+                    self.tenant_bytes.get(tenant, 0) + nbytes > t_budget
+                ):
+                    keys = self.tenant_keys.get(tenant)
+                    if not keys:
+                        break  # accounting drift: fall through to global
+                    victim = self._drop(next(iter(keys)))
+                    if victim is not None:
+                        evictions.append(victim)
             if budget:
                 while self.total_bytes + nbytes > budget and self._entries:
                     oldest = next(iter(self._entries))
                     victim = self._drop(oldest)
                     if victim is not None:
                         evictions.append(victim)
-            self._entries[key] = (weakref.ref(cache), bi, nbytes)
+            self._entries[key] = (weakref.ref(cache), bi, nbytes, tenant)
             self.total_bytes += nbytes
+            if tenant is not None:
+                self.tenant_bytes[tenant] = (
+                    self.tenant_bytes.get(tenant, 0) + nbytes
+                )
+                self.tenant_keys.setdefault(
+                    tenant, collections.OrderedDict()
+                )[key] = None
         # eviction hooks after the lock is released: a reader that races
         # in between sees either the still-resident shard (fine: shards
         # are immutable) or the evicted/spilled state
@@ -410,8 +493,14 @@ class _HbmBudget:
     def touch(self, cache: FrameCache, bi: int) -> None:
         with self._lock:
             key = (id(cache), bi)
-            if key in self._entries:
+            entry = self._entries.get(key)
+            if entry is not None:
                 self._entries.move_to_end(key)
+                tenant = entry[3]
+                if tenant is not None:
+                    keys = self.tenant_keys.get(tenant)
+                    if keys is not None and key in keys:
+                        keys.move_to_end(key)
 
     def release(self, cache: FrameCache) -> None:
         with self._lock:
@@ -430,6 +519,14 @@ def budget_bytes_resident() -> int:
     with _budget._lock:
         _budget._prune()
     return _budget.total_bytes
+
+
+def budget_bytes_by_tenant() -> Dict[str, int]:
+    """Resident bytes per tenant (the ``TFS_CACHE_TENANT_BUDGET``
+    accounting; un-tenanted caches are not listed)."""
+    with _budget._lock:
+        _budget._prune()
+        return dict(_budget.tenant_bytes)
 
 
 # ---------------------------------------------------------------------------

@@ -55,6 +55,50 @@ pooled, sharded-cache, and fault-injection legs
 output may differ from the eager chain's (derived outputs sort together
 before source passthroughs); names and values are identical.
 
+Round 19 promotes the planner into the **system-wide optimizer**
+(ISSUE 14).  Four legs on top of the round-14 chain optimizer:
+
+* **fused terminal reduce/aggregate** — a plan ending in
+  ``reduce_rows``/``reduce_blocks`` folds each block's partial INSIDE
+  the pooled chain dispatch, on the block's device, reusing the
+  engine's own ``_reduce_*_setup`` executables and finishing with the
+  engine's ``_combine_partials`` (stack in block order, re-apply once)
+  — the EXACT fold shape of the eager verbs, so bit-identity is
+  structural.  The materialized intermediate frame is eliminated
+  entirely: no per-block D2H assembly, no re-staging H2D for the
+  reduce.  A terminal ``aggregate`` (via a deferred
+  :class:`LazyGroupedFrame`) prunes the chain's fetches to exactly the
+  key + reduced columns before the one materialisation it still needs
+  (group structure is data-dependent), then runs the UNCHANGED eager
+  aggregate so grouping numerics cannot drift.
+* **cross-plan common-subexpression sharing** — a process-wide
+  plan-signature registry (source frame + step programs + live param
+  identity, weakref-guarded) lets concurrent bridge requests and
+  separate ``.lazy()`` chains with an identical subplan execute it
+  ONCE: the owner runs under a private root ledger and every consumer
+  registered by completion absorbs an exact integer share
+  (:meth:`observability.RequestLedger.absorb`, the coalescer's
+  attribution contract), so per-request ledgers still sum to the
+  global counters delta bit for bit.  Later identical chains reuse the
+  shared (auto-cached) result while it is alive (``plan_cse_hits``).
+* **pipelined multi-epoch** :func:`iterate_epochs` — the planner-aware
+  epoch driver: the entry frame's sharded cache is inserted on the
+  FIRST consumption (the loop declares its >= 2 consumptions up
+  front), evicted shards are re-staged through a background primer
+  between epochs so epoch N+1's blocks are resident while epoch N's
+  host work runs, and steady-state epochs stage 0 H2D bytes and
+  re-trace nothing.
+* **plans over streaming verbs** — stacked per-window map stages
+  (``StreamFrame.map_blocks``/``map_rows`` chains and the relational
+  pipeline's map stages) route through :func:`run_window_chain`:
+  fusion, dead-column pruning, and the static
+  ``analysis.rows_independent`` bucket pads apply per window
+  (``plan_stream_windows``).  With ``TFS_PLAN_CALIBRATE`` on, the
+  measured rows/s every plan execution records (the substance behind
+  ``explain(analyze=True)``) feeds back into the pool-vs-serial
+  decision: once both dispatches have been measured for a chain
+  signature, the faster one wins over the static intensity threshold.
+
 Knobs:
 
 * ``TFS_PLAN`` — ``1``/``true`` routes the module-level verbs through
@@ -63,12 +107,18 @@ Knobs:
 * ``TFS_PLAN_POOL_MIN_INTENSITY`` — flops/byte below which a COLD fused
   group prefers the serial fused dispatch over the device pool (default
   ``1.0``; warm executables always pool when the pool is available).
+* ``TFS_PLAN_CSE`` — cross-plan common-subexpression sharing (default
+  on for planned executions; ``0`` disables the registry).
+* ``TFS_PLAN_CALIBRATE`` — measured-throughput feedback into the
+  pool-vs-serial decision (default off; ``1`` prefers whichever
+  dispatch measured faster for the chain signature).
 """
 
 from __future__ import annotations
 
 import collections
 import logging
+import threading
 import time
 import weakref
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Set, Tuple
@@ -80,7 +130,7 @@ from .. import envutil
 from .. import roofline as _roofline
 from ..frame import TensorFrame
 from ..program import Program
-from ..schema import ColumnInfo
+from ..schema import ColumnInfo, Schema
 from . import (
     bucketing,
     device_pool,
@@ -89,7 +139,13 @@ from . import (
     prefetch,
 )
 from ..analysis import rowdep as analysis
-from .engine import _DEFAULT, Executor, GroupedFrame, _check_shape_hints
+from .engine import (
+    _DEFAULT,
+    Executor,
+    GroupedFrame,
+    _check_shape_hints,
+    _np,
+)
 from .pipeline import analyzed_outputs
 from .validation import ValidationError
 
@@ -97,7 +153,10 @@ _log = logging.getLogger("tensorframes_tpu.planner")
 
 ENV_PLAN = "TFS_PLAN"
 ENV_POOL_INTENSITY = "TFS_PLAN_POOL_MIN_INTENSITY"
+ENV_CSE = "TFS_PLAN_CSE"
+ENV_CALIBRATE = "TFS_PLAN_CALIBRATE"
 _TRUTHY = ("1", "true", "yes", "on")
+_FALSY = ("0", "false", "no", "off")
 
 
 def planning_enabled() -> bool:
@@ -105,6 +164,18 @@ def planning_enabled() -> bool:
     planner for plain frames (read per call: bench legs and tests flip
     it mid-process)."""
     return envutil.env_raw(ENV_PLAN).lower() in _TRUTHY
+
+
+def cse_enabled() -> bool:
+    """Cross-plan common-subexpression sharing (``TFS_PLAN_CSE``): on
+    by default for planned executions, ``0`` disables the registry."""
+    return envutil.env_raw(ENV_CSE).lower() not in _FALSY
+
+
+def calibrate_enabled() -> bool:
+    """Measured-throughput feedback into the pool-vs-serial decision
+    (``TFS_PLAN_CALIBRATE``, default off)."""
+    return envutil.env_raw(ENV_CALIBRATE).lower() in _TRUTHY
 
 
 def pool_min_intensity() -> float:
@@ -277,6 +348,8 @@ class _FusedMeta:
         "steps",
         "param_slots",
         "stage_specs",
+        "stage_infos",
+        "final_infos",
         "live_after",
     )
 
@@ -301,15 +374,26 @@ def _entry_signature(frame: TensorFrame) -> Tuple:
     return tuple(sorted(sig))
 
 
-def _compose(steps: Sequence[PlanStep], frame: TensorFrame) -> _FusedMeta:
+def _compose(
+    steps: Sequence[PlanStep],
+    frame: TensorFrame,
+    keep: Optional[Set[str]] = None,
+) -> _FusedMeta:
     """Analyse ``steps`` as one fused chain over ``frame``'s entry
     columns (cached): which source columns the chain consumes (its
     pruned staging set), what it produces, the per-stage specs the
     bucket-padding proof needs, and a composed probe Program whose
-    compiled HLO feeds the pool/serial cost decision."""
+    compiled HLO feeds the pool/serial cost decision.
+
+    ``keep`` (round 19, terminal fetch pruning): restrict the chain's
+    fetches to the derived columns a terminal consumer actually reads —
+    a reduce's base columns, an aggregate's keys + bases — so liveness
+    can free/donate every other intermediate and nothing unread is ever
+    assembled back to host."""
     key = (
         tuple((st.kind, id(st.program), st.trim) for st in steps),
         _entry_signature(frame),
+        None if keep is None else tuple(sorted(keep)),
     )
     hit = _FUSED_CACHE.get(key)
     if hit is not None:
@@ -328,6 +412,7 @@ def _compose(steps: Sequence[PlanStep], frame: TensorFrame) -> _FusedMeta:
     src_inputs: List[str] = []
     param_slots: List[Tuple[str, Program]] = []  # (param name, owner)
     stage_specs: List[Optional[Dict[str, Any]]] = []
+    stage_infos: List[Dict[str, ColumnInfo]] = []
     for st in steps:
         step_infos: Dict[str, ColumnInfo] = {}
         for name in st.program.input_names:
@@ -346,6 +431,7 @@ def _compose(steps: Sequence[PlanStep], frame: TensorFrame) -> _FusedMeta:
         stage_specs.append(
             analysis.input_specs_for(st.program, step_infos)
         )
+        stage_infos.append(dict(step_infos))
         outs = _analyzed_outputs_cached(
             st.program, step_infos, cell=st.kind == "map_rows"
         )
@@ -359,9 +445,12 @@ def _compose(steps: Sequence[PlanStep], frame: TensorFrame) -> _FusedMeta:
             if all(p != q for q, _ in param_slots):
                 param_slots.append((p, st.program))
     fetches = sorted(n for n, kind in origin.items() if kind == "derived")
+    if keep is not None:
+        fetches = [f for f in fetches if f in keep]
     if not fetches:
         raise ValidationError(
             "plan: the fused chain produces no derived outputs"
+            + (" the terminal consumer reads" if keep is not None else "")
         )
     pruned = sorted(set(src_infos) - set(src_inputs))
     trim = any(st.trim for st in steps)
@@ -421,6 +510,8 @@ def _compose(steps: Sequence[PlanStep], frame: TensorFrame) -> _FusedMeta:
     meta.steps = steps_t
     meta.param_slots = tuple(param_slots)
     meta.stage_specs = stage_specs
+    meta.stage_infos = stage_infos
+    meta.final_infos = dict(infos_now)
     meta.live_after = live_after
     refs = tuple(weakref.ref(st.program) for st in steps_t)
     _FUSED_CACHE[key] = (refs, meta)
@@ -438,6 +529,110 @@ def _sync_probe_params(meta: _FusedMeta) -> None:
         live = owner._params.get(p)
         if live is not None and meta.program._params.get(p) is not live:
             meta.program._params[p] = live
+
+
+# ---------------------------------------------------------------------------
+# measured-throughput calibration (TFS_PLAN_CALIBRATE, round 19)
+# ---------------------------------------------------------------------------
+#
+# Every plan execution already measures itself (`_measured`, the
+# substance behind ``explain(analyze=True)``).  With the knob on those
+# measurements feed BACK into the pool-vs-serial decision: per chain
+# signature the best observed rows/s per dispatch kind is kept, and once
+# both kinds have been measured the faster one wins over the static
+# ``TFS_PLAN_POOL_MIN_INTENSITY`` threshold — the calibration loop for
+# real TPU hosts where H2D is PCIe rather than memcpy and the roofline's
+# flops/byte alone misjudges the crossover.
+
+_CALIBRATION: "collections.OrderedDict[Any, Dict[str, float]]" = (
+    collections.OrderedDict()
+)
+_CALIBRATION_CAP = 256
+_CALIBRATION_LOCK = threading.Lock()
+
+
+def _calib_key(meta: "_FusedMeta", frame: TensorFrame) -> Tuple:
+    # fetches distinguish a keep-pruned terminal chain from the full
+    # chain of the same steps — their D2H volumes (and so their
+    # measured rows/s) are different workloads — and the frame SIZE is
+    # part of the workload too: the pool/serial crossover moves with
+    # rows and block count, so a small frame's serial win must never
+    # decide a large frame's dispatch
+    return (
+        tuple((st.kind, id(st.program), st.trim) for st in meta.steps),
+        _entry_signature(frame),
+        tuple(meta.fetches),
+        frame.num_rows,
+        frame.num_blocks,
+    )
+
+
+def _calib_entry(key: Tuple, meta: "_FusedMeta") -> Optional[Dict]:
+    """The live entry for a chain (lock held by caller).  Keys embed
+    ``id()``s, so — like ``_FUSED_CACHE`` — each record carries weakrefs
+    to its programs and a recycled id can never alias a dead chain's
+    measurements onto a different one (a stale entry is dropped)."""
+    rec = _CALIBRATION.get(key)
+    if rec is None:
+        return None
+    if not all(
+        r() is st.program for r, st in zip(rec["_refs"], meta.steps)
+    ):
+        del _CALIBRATION[key]
+        return None
+    return rec
+
+
+def _calib_note(
+    meta: "_FusedMeta", frame: TensorFrame, dispatch: str, rows_per_s
+) -> None:
+    """Record one measured pool/serial execution.  ``affinity``
+    dispatches (resident shards, ~0 H2D) are NOT folded into the pool
+    bucket — their throughput would inflate the pool estimate used to
+    decide uncached dispatches — and CSE reuses measure nothing."""
+    if rows_per_s is None or dispatch not in ("pool", "serial"):
+        return
+    key = _calib_key(meta, frame)
+    with _CALIBRATION_LOCK:
+        rec = _calib_entry(key, meta)
+        if rec is None:
+            rec = _CALIBRATION[key] = {
+                "_refs": tuple(
+                    weakref.ref(st.program) for st in meta.steps
+                ),
+            }
+        rec[dispatch] = max(rec.get(dispatch, 0.0), float(rows_per_s))
+        _CALIBRATION.move_to_end(key)
+        while len(_CALIBRATION) > _CALIBRATION_CAP:
+            _CALIBRATION.popitem(last=False)
+
+
+def _calib_lookup(
+    meta: "_FusedMeta", frame: TensorFrame
+) -> Optional[Dict[str, float]]:
+    key = _calib_key(meta, frame)
+    with _CALIBRATION_LOCK:
+        rec = _calib_entry(key, meta)
+        if rec is None:
+            return None
+        return {k: v for k, v in rec.items() if not k.startswith("_")}
+
+
+def calibration_snapshot() -> List[Dict[str, Any]]:
+    """The live calibration table (test/bench surface): one record per
+    measured chain signature with the best rows/s per dispatch kind."""
+    with _CALIBRATION_LOCK:
+        return [
+            {
+                "stages": len(k[0]),
+                **{
+                    kk: vv
+                    for kk, vv in v.items()
+                    if not kk.startswith("_")
+                },
+            }
+            for k, v in _CALIBRATION.items()
+        ]
 
 
 # ---------------------------------------------------------------------------
@@ -542,6 +737,20 @@ def _choose_dispatch(
             ).itemsize
         if max(frame.block_sizes) * per_row >= 2 * chunk:
             rec.update(decision="serial", reason="stream_chunked_blocks")
+            return rec
+    if calibrate_enabled():
+        # measured-throughput feedback (TFS_PLAN_CALIBRATE): once both
+        # dispatch kinds have real measurements for this chain
+        # signature, the observed winner overrides the static model
+        measured = _calib_lookup(meta, frame)
+        if measured and "pool" in measured and "serial" in measured:
+            if measured["pool"] >= measured["serial"]:
+                rec.update(decision="pool", reason="calibrated_pool")
+            else:
+                rec.update(decision="serial", reason="calibrated_serial")
+            rec["calibration_rows_s"] = {
+                k: round(v, 1) for k, v in measured.items()
+            }
             return rec
     if warm:
         rec.update(decision="pool", reason="warm_executables")
@@ -679,6 +888,53 @@ def _chain_pads(
     return targets
 
 
+class _TerminalReduce:
+    """The fused terminal fold (round 19): the engine-built reduce
+    executable (``_reduce_rows_setup``/``_reduce_blocks_setup`` — the
+    exact ``run`` the eager verbs dispatch) plus the base -> resolved
+    chain-output column map, applied per block INSIDE the pooled chain
+    dispatch so no intermediate frame is ever assembled."""
+
+    __slots__ = ("run", "bases", "cols", "sts", "verb")
+
+    def __init__(self, run, bases, cols, sts, verb: str):
+        self.run = run
+        self.bases = bases
+        self.cols = cols
+        self.sts = sts
+        self.verb = verb
+
+
+def _chain_fold(
+    meta: _FusedMeta,
+    terminal: _TerminalReduce,
+    staged: Dict[str, Any],
+    donate_entries: bool,
+    pad: Optional[int],
+    n_rows: int,
+) -> Optional[Dict[str, Any]]:
+    """One block's chain + terminal fold, device-resident end to end:
+    apply the stages, slice bucket pads back off, validate, then run the
+    reduce executable on the block's device.  Returns None for a block
+    whose (trimmed) output has no rows — the eager reduce skips those,
+    and the fold shape must match it exactly."""
+    outs = _apply_stages(meta, staged, donate_entries=donate_entries)
+    if pad is not None:
+        outs = {k: v[:n_rows] for k, v in outs.items()}
+    _check_chain_outputs(meta, outs, n_rows)
+    first = outs[meta.fetches[0]]
+    if first.ndim == 0 or first.shape[0] == 0:
+        return None
+    arrays = {}
+    for b in terminal.bases:
+        v = outs[terminal.cols[b]]
+        dt = terminal.sts[b].np_dtype
+        if v.dtype != dt:  # mirror the eager _device_value cast
+            v = v.astype(dt)
+        arrays[b] = v
+    return terminal.run(arrays)
+
+
 def _run_serial_chain(
     steps: Sequence[PlanStep], frame: TensorFrame
 ) -> TensorFrame:
@@ -703,7 +959,8 @@ def _run_pooled_chain(
     frame: TensorFrame,
     cache,
     devices: Sequence[Any],
-) -> Tuple[TensorFrame, Dict[str, Any]]:
+    terminal: Optional[_TerminalReduce] = None,
+) -> Tuple[Any, Dict[str, Any]]:
     """The pooled fused chain: each block stages ONCE (pruned entry
     columns, per-device staging lanes — or resident shards when the
     entry frame is sharded-cached), the whole stage chain runs on the
@@ -715,7 +972,14 @@ def _run_pooled_chain(
     fresh host buffers on the current effective device and re-run the
     chain; quarantine redirects follow ``PoolRun``.  Outputs are
     donation-adopted as the result frame's shards when sharding
-    resolves, with a GC finalizer releasing the budget."""
+    resolves, with a GC finalizer releasing the budget.
+
+    ``terminal`` (round 19): fold each block's partial on its device
+    instead of assembling any output frame — empty blocks are skipped
+    (never dispatched), partials hop async to ONE combine device
+    (``devices[0]``) in block order, and the return value is
+    ``(partials, record)`` for the caller's ``_combine_partials`` —
+    byte-for-byte the eager reduce's fold shape."""
     import jax
 
     sizes = frame.block_sizes
@@ -790,15 +1054,35 @@ def _run_pooled_chain(
     out_blocks: List[Optional[Dict[str, Any]]] = [None] * nb
     adopt_outs = (
         [None] * nb
-        if (cache is not None or len(frame_cache.shard_devices(None)) >= 2)
+        if (
+            terminal is None
+            and (
+                cache is not None
+                or len(frame_cache.shard_devices(None)) >= 2
+            )
+        )
         else None
     )
+    partials: List[Dict[str, Any]] = []
+    combine = devices[0]
     eff_assign: List[int] = []
     shard_hits = 0
     for bi in range(nb):
         cancellation.checkpoint()  # block boundary (pooled chain)
         t_blk = observability.trace_now()  # flight recorder
         di = assignment[bi]
+        if terminal is not None and sizes[bi] == 0:
+            # the eager reduce never dispatches empty blocks; consume
+            # the staged lane entry so later blocks stay aligned
+            if cache is None:
+                if session is None:
+                    next(lane_iters[di])
+                else:
+                    _DEFAULT._lane_next(
+                        lane_iters[di], lane_dead, di, session, pool
+                    )
+            eff_assign.append(di)
+            continue
         if cache is not None:
             di_eff = pool.effective_device(di) if session else di
             staged, used = (
@@ -818,9 +1102,18 @@ def _run_pooled_chain(
                 lane_iters[di], lane_dead, di, session, pool
             )
         if session is None:
-            # entry buffers donate only when freshly staged this call
-            # (never resident shards — they are shared frame state)
-            outs = _apply_stages(meta, staged, donate_entries=cache is None)
+            if terminal is not None:
+                # chain + fold, device-resident: no assembly, no frame
+                p = _chain_fold(
+                    meta, terminal, staged, cache is None,
+                    pads[bi], sizes[bi],
+                )
+            else:
+                # entry buffers donate only when freshly staged this
+                # call (never resident shards — shared frame state)
+                outs = _apply_stages(
+                    meta, staged, donate_entries=cache is None
+                )
             del staged
             di_eff = di
         else:
@@ -838,17 +1131,45 @@ def _run_pooled_chain(
                     ins = stage_block(_bi, devices[dev_i])
                 # re-staged buffers are fresh even for cached frames;
                 # attempt-0 entries are fresh only without a cache
+                if terminal is not None:
+                    # the fold rides inside the attempt so a fault at
+                    # the reduce dispatch retries the whole block
+                    return _chain_fold(
+                        meta, terminal, ins, restaged or cache is None,
+                        pads[_bi], sizes[_bi],
+                    )
                 return _apply_stages(
                     meta, ins, donate_entries=restaged or cache is None
                 )
 
-            outs = session.run(
+            res = session.run(
                 bi,
                 sizes[bi],
                 attempt,
                 device=lambda _di=di: pool.effective_device(_di),
             )
+            if terminal is not None:
+                p = res
+            else:
+                outs = res
             di_eff = pool.effective_device(di)
+        if terminal is not None:
+            if p is not None:
+                # async hop to the combine device, one reduced cell per
+                # base, in block order — the eager partials' exact shape
+                partials.append(
+                    {
+                        b: jax.device_put(p[b], combine)
+                        for b in terminal.bases
+                    }
+                )
+            eff_assign.append(di_eff)
+            pool.note_dispatch(di_eff, sizes[bi])
+            observability.trace_complete(
+                f"plan+{terminal.verb} b{bi}", f"device/{di_eff}", t_blk,
+                block=bi, rows=sizes[bi],
+            )
+            continue
         if pads[bi] is not None:
             # bucket-padded chain: slice the pad rows back off (the
             # per-stage proofs guarantee real rows' values)
@@ -863,6 +1184,20 @@ def _run_pooled_chain(
             block=bi, rows=sizes[bi],
         )
     pool.finish(out_blocks)
+    if terminal is not None:
+        rec = {
+            "device_pool": pool.record(
+                sum(ln.stats["stage_s"] for ln in lanes),
+                sum(ln.stats["wait_s"] for ln in lanes),
+            )
+        }
+        if cache is not None:
+            fc = cache.record()
+            fc["shard_hits"] = shard_hits
+            rec["frame_cache"] = fc
+        if session is not None and session.events():
+            rec["fault_tolerance"] = session.record()
+        return partials, rec
     out_frame = TensorFrame.from_blocks(out_blocks)
     if not meta.trim:
         # source columns not shadowed by chain outputs pass through
@@ -899,6 +1234,313 @@ def _run_pooled_chain(
         observability.note_plan_cache_insert()
         rec["adopted_blocks"] = adopted.resident_blocks()
     return out_frame, rec
+
+
+# ---------------------------------------------------------------------------
+# cross-plan common-subexpression sharing (round 19)
+# ---------------------------------------------------------------------------
+#
+# A process-wide plan-signature registry: two planned executions of an
+# IDENTICAL subplan — same source frame object, same step Program
+# objects at the same live-params generation, same terminal pruning —
+# execute it once.  Concurrent requests rendezvous on an in-flight
+# entry: the first claimant (the owner) runs the segment under a
+# PRIVATE root ledger, and at completion every consumer registered so
+# far (owner + waiters) absorbs an exact integer share of the measured
+# counters/blocks/rows (`RequestLedger.absorb`, the coalescer's round-16
+# attribution contract) — so per-request ledgers still SUM to the
+# global counters delta bit for bit.  Later identical chains reuse the
+# shared result while it is alive (`plan_cse_hits`); signatures embed
+# object ids but every entry holds weakrefs, so a recycled id can never
+# alias stale results onto different frames/programs.
+
+
+def _apportion_even(total: int, k: int) -> List[int]:
+    """Split ``total`` into ``k`` equal integer shares that sum exactly
+    (the shared :func:`observability.apportion` with unit weights — one
+    implementation of the attribution-critical split, not two)."""
+    return observability.apportion(int(total), [1] * k)
+
+
+def _plan_signature(
+    nodes: Sequence["LazyFrame"],
+    frame: TensorFrame,
+    keep: Optional[Set[str]],
+) -> Optional[Tuple]:
+    steps = []
+    for nd in nodes:
+        st = nd._step
+        if st is None or st.stage_bound:
+            # host-staged stages run arbitrary python per dispatch —
+            # never share their results
+            return None
+        prog = st.program
+        steps.append(
+            (
+                st.kind,
+                st.trim,
+                id(prog),
+                getattr(prog, "_params_version", 0),
+            )
+        )
+    return (
+        id(frame),
+        frame.num_rows,
+        frame.num_blocks,
+        _entry_signature(frame),
+        tuple(steps),
+        None if keep is None else tuple(sorted(keep)),
+    )
+
+
+class _CseEntry:
+    __slots__ = (
+        "event",
+        "consumers",
+        "done",
+        "failed",
+        "frame_wr",
+        "guards",
+    )
+
+    def __init__(self, frame, nodes):
+        self.event = threading.Event()
+        # (ledger-or-None, slot) per consumer registered before
+        # completion; the owner's pair is consumers[0]
+        self.consumers: List[Tuple[Any, Dict[str, Any]]] = []
+        self.done = False
+        self.failed = False
+        self.frame_wr = None
+        self.guards = [weakref.ref(frame)] + [
+            weakref.ref(nd._step.program) for nd in nodes
+        ]
+
+    def valid(self) -> bool:
+        return all(g() is not None for g in self.guards)
+
+
+class _PlanRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[Tuple, _CseEntry]" = (
+            collections.OrderedDict()
+        )
+        # signature -> {"executions", "hits", "stages"}; survives result
+        # GC so tfs.doctor()'s cse_miss rule can see repeat executions
+        self._stats: "collections.OrderedDict[Tuple, Dict[str, int]]" = (
+            collections.OrderedDict()
+        )
+        self._cap = 256
+
+    def _stat(self, sig: Tuple, stages: int) -> Dict[str, int]:
+        rec = self._stats.setdefault(
+            sig, {"executions": 0, "hits": 0, "stages": stages}
+        )
+        self._stats.move_to_end(sig)
+        while len(self._stats) > self._cap:
+            self._stats.popitem(last=False)
+        return rec
+
+    def lookup_or_claim(
+        self, sig: Tuple, frame: TensorFrame, nodes: Sequence["LazyFrame"]
+    ) -> Tuple:
+        """("hit", frame) | ("wait", slot, event) | ("own", entry)."""
+        with self._lock:
+            for key in [
+                k for k, e in self._entries.items() if not e.valid()
+            ]:
+                del self._entries[key]
+            ent = self._entries.get(sig)
+            if ent is not None:
+                if ent.done and not ent.failed:
+                    out = ent.frame_wr() if ent.frame_wr else None
+                    if out is not None:
+                        self._stat(sig, len(nodes))["hits"] += 1
+                        self._entries.move_to_end(sig)
+                        return ("hit", out)
+                    # result was garbage-collected: execute afresh
+                elif not ent.done:
+                    slot: Dict[str, Any] = {}
+                    ent.consumers.append(
+                        (observability.current_request(), slot)
+                    )
+                    # a rendezvous IS a share: count it here so the
+                    # cse_miss doctor rule cannot fire on workloads
+                    # whose sharing is always concurrent (the owner
+                    # failing is the rare corner this may overcount)
+                    self._stat(sig, len(nodes))["hits"] += 1
+                    return ("wait", slot, ent.event)
+            ent = _CseEntry(frame, nodes)
+            ent.consumers.append(
+                (observability.current_request(), {})
+            )
+            self._entries[sig] = ent
+            self._stat(sig, len(nodes))["executions"] += 1
+            while len(self._entries) > self._cap:
+                _, old = self._entries.popitem(last=False)
+                if not old.done:
+                    old.failed = True
+                    old.done = True
+                    old.event.set()
+            return ("own", ent)
+
+    def complete(self, sig: Tuple, ent: _CseEntry, out, led) -> None:
+        """Owner finished: deliver the frame to every waiter, apportion
+        the private ledger's exact delta across all consumers
+        registered by now, and downgrade the entry to a weakref.
+        Waiters that ABANDONED the rendezvous (woken early by a cap
+        eviction and already paying their own execution) are excluded —
+        absorbing a share on top of their own full delta would
+        double-bill their request ledgers."""
+        counters = {k2: v for k2, v in led.counters.items() if v}
+        blocks = dict(led.blocks_per_device)
+        # snapshot, absorb, and delivery all under the registry lock:
+        # an abandoning waiter (cap-evicted rendezvous) flips its flag
+        # under the same lock, so it is either excluded here or finds
+        # its frame delivered — never both billed and self-paying.
+        # Lock order is registry -> ledger only; ledger locks are leaf.
+        with self._lock:
+            consumers = [
+                c for c in ent.consumers if not c[1].get("abandoned")
+            ]
+            ent.frame_wr = weakref.ref(out)
+            ent.done = True
+            k = len(consumers)
+            shares = {
+                k2: _apportion_even(v, k) for k2, v in counters.items()
+            }
+            block_shares = {
+                d: _apportion_even(v, k) for d, v in blocks.items()
+            }
+            row_shares = _apportion_even(led.rows, k)
+            for i, (consumer_led, slot) in enumerate(consumers):
+                if consumer_led is not None:
+                    consumer_led.absorb(
+                        {k2: s[i] for k2, s in shares.items()},
+                        {d: s[i] for d, s in block_shares.items()},
+                        row_shares[i],
+                    )
+                slot["frame"] = out
+            # waiters hold their own slot references; dropping the list
+            # keeps the registry from pinning result frames alive
+            ent.consumers = []
+        ent.event.set()
+
+    def fail(self, sig: Tuple, ent: _CseEntry) -> None:
+        with self._lock:
+            ent.failed = True
+            ent.done = True
+            if self._entries.get(sig) is ent:
+                del self._entries[sig]
+        ent.event.set()
+
+    def stats(self) -> List[Dict[str, int]]:
+        with self._lock:
+            return [dict(v) for v in self._stats.values()]
+
+
+_REGISTRY = _PlanRegistry()
+
+
+def recent_plan_stats() -> List[Dict[str, int]]:
+    """Per-signature execution/hit counts from the CSE registry — the
+    evidence behind ``tfs.doctor()``'s ``cse_miss`` rule (injectable
+    there as ``plans=``)."""
+    return _REGISTRY.stats()
+
+
+def _cse_execute(
+    nodes: List["LazyFrame"],
+    frame: TensorFrame,
+    records: List[Dict],
+    start_idx: int,
+    cse: bool = True,
+    keep: Optional[Set[str]] = None,
+) -> TensorFrame:
+    """Execute one flush segment through the CSE registry: reuse a live
+    identical result, rendezvous with an in-flight execution, or own the
+    execution under a private root ledger and apportion its exact cost
+    across every consumer registered by completion."""
+    sig = (
+        _plan_signature(nodes, frame, keep)
+        if (cse and cse_enabled())
+        else None
+    )
+    if sig is None:
+        return _flush(nodes, frame, records, start_idx, keep=keep)
+    claim = _REGISTRY.lookup_or_claim(sig, frame, nodes)
+    verb = "+".join(nd._step.label for nd in nodes)
+    if claim[0] == "hit":
+        observability.note_plan_cse_hit()
+        records.append(
+            {
+                "stage": start_idx,
+                "verb": verb,
+                "fused": len(nodes),
+                "dispatch": "cse",
+                "reason": "registry_hit",
+                "rows": claim[1].num_rows,
+            }
+        )
+        return claim[1]
+    if claim[0] == "wait":
+        _, slot, event = claim
+        try:
+            while not event.wait(0.05):
+                cancellation.checkpoint()  # deadlines cut the wait too
+        except BaseException:
+            # cancelled mid-rendezvous: renounce the share UNDER THE
+            # LOCK so the owner's complete() cannot bill this request
+            # for a result it never received (if the frame was already
+            # delivered, the absorbed share legitimately stands)
+            with _REGISTRY._lock:
+                if slot.get("frame") is None:
+                    slot["abandoned"] = True
+            raise
+        out = slot.get("frame")
+        if out is None:
+            # woken without a result (owner failed, or the entry was
+            # cap-evicted mid-flight): declare the rendezvous abandoned
+            # UNDER THE LOCK so a late complete() cannot also absorb a
+            # share for us, then re-check — the flag and the delivery
+            # are ordered by the registry lock
+            with _REGISTRY._lock:
+                if slot.get("frame") is None:
+                    slot["abandoned"] = True
+            out = slot.get("frame")
+        if out is not None:
+            observability.note_plan_cse_hit()
+            records.append(
+                {
+                    "stage": start_idx,
+                    "verb": verb,
+                    "fused": len(nodes),
+                    "dispatch": "cse",
+                    "reason": "shared_inflight",
+                    "rows": out.num_rows,
+                }
+            )
+            return out
+        # the owner failed (or was evicted mid-flight): pay our own way
+        return _flush(nodes, frame, records, start_idx, keep=keep)
+    ent = claim[1]
+    # the owner's execution runs under a PRIVATE root ledger so its
+    # delta can be apportioned exactly; the suspended request context
+    # gets its share back through absorb (consumers[0] is the owner)
+    tok0 = observability.activate_request(None)
+    led = observability.RequestLedger(method="plan_cse")
+    tok1 = observability.activate_request(led)
+    try:
+        out = _flush(nodes, frame, records, start_idx, keep=keep)
+    except BaseException:
+        observability.deactivate_request(tok1)
+        observability.deactivate_request(tok0)
+        _REGISTRY.fail(sig, ent)
+        raise
+    observability.deactivate_request(tok1)
+    observability.deactivate_request(tok0)
+    _REGISTRY.complete(sig, ent, out, led)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -952,6 +1594,23 @@ class LazyFrame:
     def lazy(self) -> "LazyFrame":
         return self
 
+    # guards shared plan-tree bookkeeping (root get-or-create, child
+    # registration): concurrent bridge requests append chains to ONE
+    # shared per-frame root, and unlocked read-modify-writes there
+    # would lose consumer counts / drop live child refs — starving the
+    # auto-cache trigger and _needed_below's cached-column set
+    _TREE_LOCK = threading.Lock()
+
+    def _bump(self, attr: str) -> int:
+        """Locked increment for shared-node consumer bookkeeping
+        (``_children``/``_mat_uses``): concurrent requests off one
+        shared root must not lose counts — the auto-cache trigger
+        reads them."""
+        with LazyFrame._TREE_LOCK:
+            v = getattr(self, attr) + 1
+            setattr(self, attr, v)
+            return v
+
     def _append(
         self,
         kind: str,
@@ -961,23 +1620,61 @@ class LazyFrame:
     ) -> "LazyFrame":
         step = PlanStep(kind, program, trim=trim, host_stage=host_stage)
         child = LazyFrame(parent=self, step=step)
-        if len(self._child_refs) >= 32:
-            # epochs loops re-derive from one shared root every pass:
-            # drop dead consumer refs so the list stays bounded by the
-            # LIVE fan-out, not the plan's lifetime
-            self._child_refs = [
-                r for r in self._child_refs if r() is not None
-            ]
-        self._child_refs.append(weakref.ref(child))
-        self._children += 1
+        with LazyFrame._TREE_LOCK:
+            if len(self._child_refs) >= 32:
+                # epochs loops re-derive from one shared root every
+                # pass: drop dead consumer refs so the list stays
+                # bounded by the LIVE fan-out, not the plan's lifetime
+                self._child_refs = [
+                    r for r in self._child_refs if r() is not None
+                ]
+            self._child_refs.append(weakref.ref(child))
+            self._children += 1  # lock already held (non-reentrant)
         return child
 
     def group_by(self, *keys: str) -> GroupedFrame:
-        """Materialise and group — ``aggregate`` is a materialisation
-        point (its group structure is data-dependent)."""
-        self._children += 1
-        mat = self._materialize(needed_hint=set(keys))
-        return GroupedFrame(mat, keys)
+        """Group for ``aggregate``.  An unmaterialised plan defers the
+        materialisation to the aggregate itself (round 19): the
+        aggregate then knows exactly which chain outputs it reads, so
+        the one materialisation it still needs (group structure is
+        data-dependent) fetches ONLY the key + reduced columns.  Key
+        contracts are still checked HERE whenever the chain's schema is
+        statically known — deferral must not move the eager call-site
+        error to aggregate time."""
+        self._bump("_children")
+        if self._materialized is not None:
+            return GroupedFrame(self._materialized, keys)
+        if keys:
+            self._check_group_keys(keys)
+        return LazyGroupedFrame(self, keys)
+
+    def _check_group_keys(self, keys: Sequence[str]) -> None:
+        """The eager ``GroupedFrame`` constructor's key checks, run
+        against the chain's statically inferred output schema (entry
+        columns + analyzed derived columns).  An opaque chain (host
+        stages, unresolvable inputs) defers to aggregate time."""
+        chain: List[LazyFrame] = []
+        cur = self
+        while cur._materialized is None:
+            chain.append(cur)
+            cur = cur._parent
+        chain.reverse()
+        src = cur._materialized
+        if src is None or not chain:
+            return
+        steps = [nd._step for nd in chain]
+        n, _, _ = _fusable_run(steps, _device_infos(src))
+        if n != len(steps):
+            return  # schema not statically known: checked at aggregate
+        meta = _compose(steps, src)
+        shim = _SchemaShim(src, meta.final_infos, trim=meta.trim)
+        for k in keys:
+            ci = shim.schema[k]  # raises SchemaError exactly like eager
+            if ci.cell_shape.rank != 0:
+                raise ValidationError(
+                    f"group_by: key column {k!r} must be scalar, has "
+                    f"cell shape {ci.cell_shape}"
+                )
 
     def frame(self) -> TensorFrame:
         """Force execution and return the materialised TensorFrame."""
@@ -989,10 +1686,18 @@ class LazyFrame:
         self,
         needed_hint: Optional[Set[str]] = None,
         count_use: bool = True,
+        keep: Optional[Set[str]] = None,
+        cse: bool = True,
     ) -> TensorFrame:
+        """Execute the plan.  ``keep`` (round 19): prune the FINAL fused
+        group's fetches to the named derived columns (a terminal
+        consumer's read set) — the result is then partial by design and
+        is NOT memoized on the node.  ``cse=False`` bypasses the
+        cross-plan registry (per-window streaming plans, whose source
+        frames never repeat)."""
         if self._materialized is not None:
             if count_use:
-                self._mat_uses += 1
+                self._bump("_mat_uses")
                 if self._mat_uses >= 2:
                     self._ensure_auto_cache(needed_hint)
             return self._materialized
@@ -1008,7 +1713,7 @@ class LazyFrame:
         frame = entry._materialized
         # one more dispatch reads the shared entry: promote it to an
         # auto cache on its second consumption (the epochs pattern)
-        entry._mat_uses += 1
+        entry._bump("_mat_uses")
         if entry._mat_uses >= 2:
             entry._ensure_auto_cache(_first_step_cols(chain) or needed_hint)
 
@@ -1022,7 +1727,9 @@ class LazyFrame:
                 pending.append(nd)
                 if nd._children >= 2 and nd is not chain[-1]:
                     # shared subplan: materialisation barrier + cache
-                    frame = _flush(pending, frame, records, done)
+                    frame = _cse_execute(
+                        pending, frame, records, done, cse=cse
+                    )
                     done += len(pending)
                     pending = []
                     nd._materialized = frame
@@ -1030,7 +1737,9 @@ class LazyFrame:
                     nd._ensure_auto_cache(None)
                     frame = nd._materialized
             if pending:
-                frame = _flush(pending, frame, records, done)
+                frame = _cse_execute(
+                    pending, frame, records, done, cse=cse, keep=keep
+                )
             span.annotate(
                 "planner",
                 {
@@ -1043,12 +1752,19 @@ class LazyFrame:
                     ),
                 },
             )
-        self._materialized = frame
-        self._mat_uses = 1
+        if keep is None:
+            self._materialized = frame
+            self._mat_uses = 1
         self._last_records = records
         return frame
 
     # -- auto cache ----------------------------------------------------------
+
+    # serializes auto-cache insertion across threads: concurrent bridge
+    # requests materializing off one shared root must not both pass the
+    # check-then-act and build two caches for one frame (the loser's
+    # shards would stay charged against TFS_HBM_BUDGET until frame GC)
+    _AUTOCACHE_LOCK = threading.Lock()
 
     def _ensure_auto_cache(
         self, needed_hint: Optional[Set[str]] = None
@@ -1064,29 +1780,32 @@ class LazyFrame:
         mat = self._materialized
         if mat is None or self._auto_cached:
             return
-        if frame_cache.active_cache(mat) is not None:
-            self._auto_cached = True  # adopted / user-cached already
-            return
-        devs = frame_cache.shard_devices(None)
-        if len(devs) < 2:
-            return
-        needed, everything = self._needed_below()
-        if needed_hint:
-            needed |= set(needed_hint)
-        cacheable = [
-            name
-            for name in _device_infos(mat)
-            if not mat.column(name).is_device
-            and (everything or name in needed)
-        ]
-        if not cacheable:
-            return
-        cache = frame_cache.build(mat, sorted(cacheable), devices=devs)
-        if cache is None:
-            return
-        frame_cache.attach(mat, cache)
-        self._finalizer = weakref.finalize(mat, _release_cache, cache)
-        self._auto_cached = True
+        with LazyFrame._AUTOCACHE_LOCK:
+            if self._auto_cached:
+                return
+            if frame_cache.active_cache(mat) is not None:
+                self._auto_cached = True  # adopted / user-cached already
+                return
+            devs = frame_cache.shard_devices(None)
+            if len(devs) < 2:
+                return
+            needed, everything = self._needed_below()
+            if needed_hint:
+                needed |= set(needed_hint)
+            cacheable = [
+                name
+                for name in _device_infos(mat)
+                if not mat.column(name).is_device
+                and (everything or name in needed)
+            ]
+            if not cacheable:
+                return
+            cache = frame_cache.build(mat, sorted(cacheable), devices=devs)
+            if cache is None:
+                return
+            frame_cache.attach(mat, cache)
+            self._finalizer = weakref.finalize(mat, _release_cache, cache)
+            self._auto_cached = True
         observability.note_plan_cache_insert()
         _log.info(
             "planner: auto-inserted sharded cache over %s (%d consumers)",
@@ -1121,17 +1840,226 @@ class LazyFrame:
     # -- terminal verbs ------------------------------------------------------
 
     def _reduce(self, verb: str, program: Program, mode: str = "tree"):
-        self._children += 1
+        self._bump("_children")
+        if self._materialized is None:
+            out = self._fused_terminal_reduce(verb, program, mode)
+            if out is not None:
+                return out
         mat = self._materialize(needed_hint=_reduce_cols(program))
         if verb == "reduce_rows":
             return _DEFAULT.reduce_rows(program, mat, mode=mode)
         return _DEFAULT.reduce_blocks(program, mat)
+
+    def _terminal_chain(self):
+        """The unmaterialised step chain back to the nearest memo/root,
+        or None when a terminal fusion cannot apply: no steps, an
+        interior shared subplan (its memoized barrier is worth more than
+        the fold), or an unfusable run (host stages, ragged inputs)."""
+        chain: List[LazyFrame] = []
+        cur = self
+        while cur._materialized is None:
+            chain.append(cur)
+            cur = cur._parent
+        chain.reverse()
+        frame = cur._materialized
+        if not chain or frame.num_rows == 0:
+            return None
+        if any(nd._children >= 2 for nd in chain[:-1]):
+            return None
+        steps = [nd._step for nd in chain]
+        n, _, _ = _fusable_run(steps, _device_infos(frame))
+        if n != len(steps):
+            return None
+        return cur, chain, steps, frame
+
+    def _fused_terminal_reduce(self, verb: str, program: Program, mode):
+        """The round-19 fused terminal fold: when the whole pending
+        chain is one fusable run, its dispatch would pool, and every
+        reduce base resolves to a chain output, fold each block's
+        partial inside the pooled chain dispatch — no intermediate
+        frame is ever assembled (no D2H readback, no re-staging H2D) —
+        then finish with the engine's own ``_combine_partials``.
+        Returns None whenever the eager materialize-then-reduce path
+        should run instead (bit-identical either way: the fold shape,
+        executables, and combine device are the eager ones)."""
+        tc = self._terminal_chain()
+        if tc is None:
+            return None
+        entry, chain, steps, frame = tc
+        meta0 = _compose(steps, frame)
+        if meta0.trim:
+            # trimmed chains have program-defined per-block row counts;
+            # the materialized path keeps their contract checks simple
+            return None
+        # the engine's own setup over the chain's inferred output
+        # schema — contract violations surface exactly like eager
+        shim = _SchemaShim(frame, meta0.final_infos)
+        if verb == "reduce_rows":
+            bases, reduced, run = _DEFAULT._reduce_rows_setup(
+                program, shim, mode
+            )
+        else:
+            bases, reduced, run = _DEFAULT._reduce_blocks_setup(
+                program, shim
+            )
+        cols = {b: reduced[b].name for b in bases}
+        if not all(cols[b] in set(meta0.fetches) for b in bases):
+            # the reduce reads a source/passthrough column the chain
+            # does not produce: materialize (it must be staged anyway)
+            return None
+        meta = _compose(steps, frame, keep=set(cols.values()))
+        warm = any(nd._runs > 0 for nd in chain) or _chain_warm(steps)
+        rec = _choose_dispatch(meta, frame, warm)
+        decision = rec.pop("decision")
+        reason = rec.pop("reason")
+        if decision not in ("pool", "affinity"):
+            # serial: the fused-serial chain + eager reduce IS the
+            # baseline (device-resident, single device) — no round trip
+            # to eliminate
+            return None
+        sts = {b: dtypes.coerce(reduced[b].scalar_type) for b in bases}
+        terminal = _TerminalReduce(run, bases, cols, sts, verb)
+        # one more consumption of the shared entry (epochs promotion)
+        entry._bump("_mat_uses")
+        if entry._mat_uses >= 2:
+            entry._ensure_auto_cache(_first_step_cols(chain))
+        records: List[Dict[str, Any]] = []
+        with observability.verb_span(
+            "plan", frame.num_rows, frame.num_blocks
+        ) as span:
+            cache = frame_cache.active_cache(frame)
+            devices = (
+                cache.devices
+                if cache is not None
+                else device_pool.pool_devices()
+            )
+            (partials, run_rec), measured = _measured(
+                lambda: _run_pooled_chain(
+                    meta, frame, cache, devices, terminal=terminal
+                ),
+                frame.num_rows,
+            )
+            rec.update(run_rec)
+            rec.update(measured)
+            # feed the calibration table too (keep-pruned fetch key —
+            # a different workload from the full chain's); terminal
+            # chains only ever measure the pooled side (their serial
+            # decision falls back to materialize-then-reduce), so the
+            # calibrated override stays inert for them until a serial
+            # measurement exists — one-sided entries never decide
+            _calib_note(
+                meta, frame, decision, measured.get("rows_per_s")
+            )
+            if len(steps) >= 2:
+                observability.note_plan_fused_dispatch()
+            observability.note_plan_fused_reduce()
+            if meta.pruned:
+                observability.note_plan_columns_pruned(len(meta.pruned))
+            records.append(
+                {
+                    "stage": 0,
+                    "verb": "+".join(st.label for st in steps)
+                    + f"+{verb}",
+                    "fused": len(steps) + 1,
+                    "dispatch": decision,
+                    "reason": reason,
+                    "terminal": verb,
+                    "pruned": list(meta.pruned),
+                    **rec,
+                }
+            )
+            final = _DEFAULT._combine_partials(run, bases, partials)
+            out = {b: _np(final[b]) for b in bases}
+            span.annotate(
+                "planner",
+                {
+                    "stages": records,
+                    "fused_groups": 1,
+                    "fused_terminal": verb,
+                },
+            )
+        for nd in chain:
+            nd._runs += 1
+        self._last_records = records
+        return out
+
+    def _aggregate_terminal(
+        self,
+        program: Program,
+        keys: Sequence[str],
+        grouped: Optional["LazyGroupedFrame"] = None,
+    ) -> TensorFrame:
+        """Terminal-pruned aggregate (round 19): materialise the chain
+        fetching ONLY the key + reduced columns the aggregate reads
+        (everything else is never assembled to host), then run the
+        UNCHANGED eager aggregate over it — grouping numerics are the
+        eager engine's, bit for bit.
+
+        Repeat aggregates over one ``grouped`` handle stay
+        materialize-once: a pruned result is memoized on the handle per
+        read set, and a SECOND aggregate with a different read set
+        switches to the full (node-memoized) materialisation — the
+        round-14 behavior — instead of re-executing the chain per
+        program."""
+        from .validation import check_reduce_blocks
+
+        tc = self._terminal_chain()
+        if tc is None or self._materialized is not None:
+            mat = self._materialize(needed_hint=set(keys))
+            return _DEFAULT.aggregate(program, GroupedFrame(mat, keys))
+        entry, chain, steps, frame = tc
+        meta0 = _compose(steps, frame)
+        shim = _SchemaShim(frame, meta0.final_infos, trim=meta0.trim)
+        reduced = check_reduce_blocks(program, shim, verb="aggregate")
+        needed = set(keys) | {ci.name for ci in reduced.values()}
+        keep = needed & set(meta0.fetches)
+        fz = frozenset(keep) if keep else None
+        if grouped is not None:
+            hit = grouped._pruned.get(fz)
+            if hit is not None:
+                return _DEFAULT.aggregate(
+                    program, GroupedFrame(hit, keys)
+                )
+            if grouped._agg_count >= 1:
+                # second aggregate with a NEW read set: one full
+                # materialisation (memoized on the node) serves this
+                # and every later aggregate/frame() for free
+                mat = self._materialize(needed_hint=needed)
+                grouped._agg_count += 1
+                return _DEFAULT.aggregate(
+                    program, GroupedFrame(mat, keys)
+                )
+        mat = self._materialize(
+            needed_hint=needed,
+            count_use=False,
+            keep=keep or None,
+        )
+        # the counter tracks ACTUAL fetch pruning: keep applies only to
+        # a fused tail group dispatched pooled/affinity — a lone eager
+        # stage always computes its full fetch set, and the fused-
+        # SERIAL leg runs the eager per-stage chain (keep ignored)
+        if keep and any(
+            r.get("fused", 0) >= 2
+            and r.get("dispatch") in ("pool", "affinity")
+            for r in self._last_records
+        ):
+            observability.note_plan_fused_reduce()
+        if grouped is not None:
+            grouped._pruned[fz] = mat
+            grouped._agg_count += 1
+        return _DEFAULT.aggregate(program, GroupedFrame(mat, keys))
 
     # -- surface -------------------------------------------------------------
 
     @property
     def is_materialized(self) -> bool:
         return self._materialized is not None
+
+    def warmup(self) -> List[str]:
+        """Prime the fused-chain executables this plan will actually
+        dispatch — bucketed sizes, donating entries, every pool device —
+        without executing the plan (:func:`warm_plan`)."""
+        return warm_plan(self)
 
     def explain_plan(self) -> str:
         return explain_plan(self)
@@ -1148,6 +2076,55 @@ class LazyFrame:
 
     def __repr__(self):
         return self.explain_plan()
+
+
+class _SchemaShim:
+    """Schema-only stand-in for a chain's (never materialised) output
+    frame — exactly the surface the engine's reduce/aggregate setup and
+    validation read: ``schema``, ``num_rows``, ``block_sizes``.  Derived
+    chain outputs shadow same-named source columns; untouched source
+    columns pass through (the non-trimmed chain contract).  A TRIMMED
+    chain drops every passthrough, so its shim carries ONLY the derived
+    columns — merging entry columns would falsely validate keys the
+    real output frame will not have."""
+
+    __slots__ = ("schema", "num_rows", "block_sizes")
+
+    def __init__(
+        self,
+        entry: TensorFrame,
+        final_infos: Mapping[str, ColumnInfo],
+        trim: bool = False,
+    ):
+        cols: Dict[str, ColumnInfo] = (
+            {} if trim else {ci.name: ci for ci in entry.schema}
+        )
+        cols.update(final_infos)
+        self.schema = Schema(list(cols.values()))
+        self.num_rows = entry.num_rows
+        self.block_sizes = list(entry.block_sizes)
+
+
+class LazyGroupedFrame(GroupedFrame):
+    """``lazy.group_by(...)`` over an unmaterialised plan: the grouping
+    is deferred to ``aggregate``, which knows its read set and prunes
+    the chain's fetches to exactly keys + reduced columns
+    (:meth:`LazyFrame._aggregate_terminal`).  Accessing ``.frame``
+    materialises the full plan (the eager escape hatch)."""
+
+    def __init__(self, lazy: "LazyFrame", keys: Sequence[str]):
+        if not keys:
+            raise ValidationError("group_by needs at least one key column")
+        self.lazy = lazy
+        self.keys = list(keys)
+        # materialize-once across repeat aggregates: pruned results per
+        # read set, and the count that flips to full materialisation
+        self._pruned: Dict[Optional[frozenset], TensorFrame] = {}
+        self._agg_count = 0
+
+    @property
+    def frame(self) -> TensorFrame:
+        return self.lazy._materialize(count_use=False)
 
 
 def _release_cache(cache) -> None:
@@ -1192,18 +2169,24 @@ def _flush(
     frame: TensorFrame,
     records: List[Dict],
     start_idx: int,
+    keep: Optional[Set[str]] = None,
 ) -> TensorFrame:
     """Execute ``nodes``' steps over ``frame``: maximal fusable runs
     dispatch as ONE chained pass; everything else (host-staged,
     ragged-input, lone stages) runs the plain eager verb — the same
-    dispatch the eager path would make."""
+    dispatch the eager path would make.  ``keep`` prunes the fetches of
+    a fused group that ENDS the segment (terminal consumers)."""
     i = 0
     while i < len(nodes):
         steps = [nd._step for nd in nodes[i:]]
         n, why, _ = _fusable_run(steps, _device_infos(frame))
         if n >= 2:
             frame = _dispatch_fused(
-                nodes[i : i + n], frame, records, start_idx + i
+                nodes[i : i + n],
+                frame,
+                records,
+                start_idx + i,
+                keep=keep if i + n == len(nodes) else None,
             )
             i += n
         else:
@@ -1294,9 +2277,16 @@ def _dispatch_fused(
     frame: TensorFrame,
     records: List[Dict],
     idx: int,
+    keep: Optional[Set[str]] = None,
 ) -> TensorFrame:
     steps = [nd._step for nd in group]
-    meta = _compose(steps, frame)
+    try:
+        meta = _compose(steps, frame, keep=keep)
+    except ValidationError:
+        if keep is None:
+            raise
+        # the terminal reads no derived column: nothing to prune
+        meta = _compose(steps, frame)
     warm = any(nd._runs > 0 for nd in group) or _chain_warm(steps)
     rec = _choose_dispatch(meta, frame, warm)
     decision = rec.pop("decision")
@@ -1322,6 +2312,9 @@ def _dispatch_fused(
             lambda: _run_serial_chain(steps, frame), frame.num_rows
         )
     rec.update(measured)
+    # measured-throughput feedback (TFS_PLAN_CALIBRATE reads it back
+    # through _choose_dispatch on the next identical chain)
+    _calib_note(meta, frame, decision, measured.get("rows_per_s"))
     observability.note_plan_fused_dispatch()
     if meta.pruned:
         observability.note_plan_columns_pruned(len(meta.pruned))
@@ -1350,11 +2343,16 @@ def root_for(frame: TensorFrame) -> LazyFrame:
     """The ONE shared plan root for a TensorFrame object (get-or-create)
     — used by both ``frame.lazy()`` and the ``TFS_PLAN`` routing, so
     chains built from either entry count as consumers of the same
-    subplan (the auto-cache trigger)."""
+    subplan (the auto-cache trigger).  Locked: two concurrent bridge
+    requests racing the create would otherwise each get a root and
+    split the consumer counting."""
     root = getattr(frame, "_tfs_lazy_root", None)
     if root is None:
-        root = LazyFrame(source=frame)
-        frame._tfs_lazy_root = root
+        with LazyFrame._TREE_LOCK:
+            root = getattr(frame, "_tfs_lazy_root", None)
+            if root is None:
+                root = LazyFrame(source=frame)
+                frame._tfs_lazy_root = root
     return root
 
 
@@ -1375,6 +2373,248 @@ def ensure_frame(frame):
     if isinstance(frame, LazyFrame):
         return frame._materialize(count_use=False)
     return frame
+
+
+# ---------------------------------------------------------------------------
+# plan warmup (round 19 satellite: the fused-chain bucket grid)
+# ---------------------------------------------------------------------------
+
+
+def warm_plan(frame: "LazyFrame") -> List[str]:
+    """Prime the executables the optimizer will ACTUALLY dispatch for
+    this plan, without executing it.
+
+    ``Executor.warmup`` primes one program's own entries, but a planned
+    chain dispatches each stage through the engine's DONATING entries at
+    BUCKETED sizes on every pool device — different jit-cache keys, so a
+    per-stage warmup still left the first planned run compiling.  This
+    walks the pending chain, composes the fused groups, and zeros-
+    executes the exact ``_apply_stages`` path once per (bucketed size,
+    device) with trace counting suppressed (programs are pure by
+    contract), seeding the jit caches — and, with ``TFS_COMPILE_CACHE``
+    configured, the persistent cache — the first real dispatch will hit.
+    The roofline probe and the bucket-pad proofs are primed too, so the
+    pool-vs-serial decision costs nothing at dispatch.  Returns the
+    primed (rows x devices) grid labels."""
+    import jax
+
+    if not isinstance(frame, LazyFrame):
+        raise ValidationError("warm_plan: takes a LazyFrame")
+    chain: List[LazyFrame] = []
+    cur = frame
+    while cur._materialized is None:
+        chain.append(cur)
+        cur = cur._parent
+    chain.reverse()
+    src = cur._materialized
+    if src is None or not chain or src.num_rows == 0:
+        return []
+    steps = [nd._step for nd in chain]
+    n, _, _ = _fusable_run(steps, _device_infos(src))
+    if n < 2:
+        st = steps[0]
+        if st.stage_bound or st.kind not in ("map_blocks", "map_rows"):
+            return []
+        fps = _DEFAULT.warmup(
+            st.program,
+            src,
+            rows_level=st.kind == "map_rows",
+            host_stage=st.host_stage,
+        )
+        return list(fps)
+    meta = _compose(steps[:n], src)
+    pads = _chain_pads(meta, src)
+    sizes = src.block_sizes
+    exec_sizes = sorted(
+        {
+            pads[bi] if pads[bi] is not None else s
+            for bi, s in enumerate(sizes)
+            if s > 0
+        }
+    )
+    if not exec_sizes:
+        return []
+    cache = frame_cache.active_cache(src)
+    if cache is not None:
+        devs = [cache.devices[di] for di in sorted(set(cache.assignment))]
+    else:
+        devs = list(device_pool.pool_devices()) or [None]
+    # prime the cost probe so the first dispatch's pool/serial decision
+    # is a cache hit instead of a compile
+    _fused_intensity(meta.program, src)
+    donate_entries = cache is None
+    # real sizes each bucket serves: the dispatch slices pads back off,
+    # and that slice is its own (per-device) executable to prime
+    reals: Dict[int, Set[int]] = {}
+    for bi, s in enumerate(sizes):
+        if s > 0 and pads[bi] is not None:
+            reals.setdefault(pads[bi], set()).add(s)
+    primed: List[str] = []
+    for n_rows in exec_sizes:
+        zeros = {}
+        for name in meta.src_inputs:
+            col = src.column(name)
+            cell = tuple(np.shape(col.data)[1:])
+            st_ = dtypes.coerce(col.info.scalar_type)
+            zeros[name] = np.zeros((n_rows,) + cell, st_.np_dtype)
+        for dev in devs:
+            staged = {
+                k: jax.device_put(v, dev) for k, v in zeros.items()
+            }
+            with observability.suppress_trace_count():
+                outs = _apply_stages(
+                    meta, staged, donate_entries=donate_entries
+                )
+                for real in sorted(reals.get(n_rows, ())):
+                    sliced = {k: v[:real] for k, v in outs.items()}
+                    jax.block_until_ready(list(sliced.values()))
+            jax.block_until_ready(outs)
+            primed.append(
+                f"chain[{len(meta.steps)}]x{n_rows}@"
+                f"{getattr(dev, 'id', 'default')}"
+            )
+    return primed
+
+
+# ---------------------------------------------------------------------------
+# planner-aware multi-epoch driver (round 19)
+# ---------------------------------------------------------------------------
+
+
+def _prime_blocks(frame, cache, missing: List[int]) -> None:
+    """Best-effort background re-staging of evicted entry shards
+    between epochs: spill-backed shards restore from disk, plain shards
+    re-stage from the authoritative host columns.  Any failure simply
+    leaves the block for the dispatch path's inline re-staging."""
+    import jax
+
+    names = None
+    for b in cache.blocks:
+        if b is not None:
+            names = list(b)
+            break
+    for bi in missing:
+        try:
+            if cache.shard(bi) is not None:  # spill restore / raced in
+                continue
+            if names is None:
+                return
+            dev = cache.devices[cache.assignment[bi]]
+            lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+            shard = {}
+            for name in names:
+                col = frame.column(name)
+                a = np.asarray(col.data)[lo:hi]
+                st_ = dtypes.coerce(col.info.scalar_type)
+                if a.dtype != st_.np_dtype:
+                    a = a.astype(st_.np_dtype)
+                observability.note_h2d_bytes(a.nbytes)
+                shard[name] = jax.device_put(a, dev)
+            if not cache.insert(bi, shard):
+                return  # budget full: stop, dispatch re-stages inline
+        except Exception:  # noqa: BLE001 — priming must never fail a run
+            return
+
+
+def _start_epoch_primer(root: "LazyFrame"):
+    mat = root._materialized
+    if mat is None:
+        return None
+    cache = frame_cache.active_cache(mat)
+    if cache is None:
+        return None
+    missing = [bi for bi, b in enumerate(cache.blocks) if b is None]
+    if not missing:
+        return None
+    t = threading.Thread(
+        target=_prime_blocks,
+        args=(mat, cache, missing),
+        daemon=True,
+        name="tfs-plan-epoch-primer",
+    )
+    t.start()
+    return t
+
+
+def iterate_epochs(frame, step, epochs: int) -> List[Any]:
+    """Planner-aware multi-epoch driver (``tfs.iterate_epochs``): run
+    ``step(lazy_frame, epoch)`` ``epochs`` times over one shared plan
+    root.
+
+    The planner knows the loop shape up front, so it does what the
+    round-14 heuristics only discovered mid-loop: the entry frame's
+    sharded cache inserts on the FIRST consumption (not the second), so
+    epoch 1 onwards reads resident shards — 0 steady-state H2D — and
+    between epochs a background primer re-stages any shards the
+    ``TFS_HBM_BUDGET`` LRU evicted, through the same staging path, so
+    epoch N+1's blocks are resident while epoch N's host work (loss
+    handling, param updates) runs.  Steady-state epochs re-trace
+    nothing: the chain's executables and fusion metadata are shared
+    across epochs.
+
+    ``step`` receives the shared :class:`LazyFrame` root and the epoch
+    index; derive chains and reduce/aggregate off it exactly as in a
+    hand-written loop (params may change between epochs via
+    ``update_params`` — the plan re-executes, the executables stay
+    warm).  Returns the per-epoch results."""
+    if epochs < 1:
+        raise ValidationError("iterate_epochs: epochs must be >= 1")
+    if isinstance(frame, LazyFrame):
+        root = frame
+    elif isinstance(frame, TensorFrame):
+        root = root_for(frame)
+    else:
+        raise ValidationError(
+            "iterate_epochs: takes a TensorFrame or LazyFrame"
+        )
+    if epochs >= 2 and root._materialized is not None:
+        # declare the loop's >= 2 consumptions up front: the entry
+        # auto-cache triggers on the FIRST consumption instead of
+        # waiting to observe a second one
+        root._mat_uses = max(root._mat_uses, 1)
+    results: List[Any] = []
+    primer = None
+    try:
+        for e in range(epochs):
+            cancellation.checkpoint()  # epoch boundary
+            results.append(step(root, e))
+            # the primer runs CONCURRENTLY with the next epoch (the
+            # overlap is the point: re-staging evicted shards rides
+            # under epoch N+1's host work; the dispatch path tolerates
+            # racing best-effort inserts — worst case a block re-stages
+            # inline exactly as it would have without the primer).  At
+            # most one primer is in flight.
+            if e + 1 < epochs and (primer is None or not primer.is_alive()):
+                primer = _start_epoch_primer(root)
+    finally:
+        if primer is not None:
+            primer.join()
+    return results
+
+
+# ---------------------------------------------------------------------------
+# per-window plans for the streaming verbs (round 19)
+# ---------------------------------------------------------------------------
+
+
+def run_window_chain(
+    frame: TensorFrame, steps: Sequence[Tuple[str, Program, bool]]
+) -> TensorFrame:
+    """Execute a stacked map chain over ONE streaming window through
+    plan construction: fusion, dead-column pruning, and the static
+    ``analysis.rows_independent`` bucket pads all apply, and the fusion
+    metadata / executables are shared across windows (the stage
+    Programs are the cache keys).  The CSE registry is bypassed —
+    window frames never repeat.  Bit-identical to dispatching the
+    stages eagerly per window: the fused chain applies each stage's own
+    compiled entry."""
+    lz = LazyFrame(source=frame)
+    cur = lz
+    for kind, program, trim in steps:
+        cur = cur._append(kind, program, trim=trim)
+    out = cur._materialize(count_use=False, cse=False)
+    observability.note_plan_stream_window()
+    return out
 
 
 def explain_plan(frame: LazyFrame) -> str:

@@ -120,6 +120,11 @@ class Program:
             k: jax.tree_util.tree_map(jnp.asarray, v)
             for k, v in (params or {}).items()
         }
+        # monotonic params generation: bumped by update_params so caches
+        # keyed on live param VALUES (the planner's cross-plan CSE
+        # registry) can tell two states of one Program apart without
+        # holding or hashing the arrays themselves
+        self._params_version = 0
         for k in self._params:
             if k not in all_names:
                 raise ProgramError(
@@ -302,6 +307,11 @@ class Program:
         are *traced arguments* of the compiled executable, so a shape-stable
         update reuses the jit cache — no re-trace, no re-compile, no
         re-broadcast."""
+        # validate EVERY key before mutating anything: a mid-loop raise
+        # must not leave _params half-updated at the old version — the
+        # planner's CSE registry keys on (id, _params_version) and a
+        # silent partial update would let it serve stale results
+        validated: Dict[str, Any] = {}
         for k, v in arrays.items():
             if k not in self._params:
                 raise ProgramError(
@@ -326,7 +336,9 @@ class Program:
                         f"(shape changes force a re-compile; build a new "
                         f"Program instead)"
                     )
-            self._params[k] = new
+            validated[k] = new
+        self._params.update(validated)
+        self._params_version += 1
         return self
 
     def column_for_input(self, name: str) -> str:

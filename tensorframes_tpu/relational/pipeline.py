@@ -57,34 +57,12 @@ logger = logging.getLogger("tensorframes_tpu.relational")
 _MAP_OPS = ("map_rows", "map_blocks")
 
 
-class _MappedStream(StreamFrame):
-    """A map stage lazily applied per window (the stage's Program — and
-    its hot executables — shared across windows)."""
-
-    def __init__(self, inner: StreamFrame, program, op: str, trim: bool,
-                 engine):
-        super().__init__(
-            source=lambda: iter(()),
-            window_rows=inner.window_rows or None,
-            num_blocks=inner._num_blocks,
-            num_rows=inner.num_rows if not trim else None,
-            reiterable=True,
-            label=f"{op}({inner._label})",
-        )
-        self._inner = inner
-        self._program = program
-        self._op = op
-        self._trim = trim
-        self._engine = engine
-
-    def windows(self):
-        ex = _resolve(self._engine)
-        for wf in self._inner.windows():
-            cancellation.checkpoint()
-            if self._op == "map_rows":
-                yield ex.map_rows(self._program, wf)
-            else:
-                yield ex.map_blocks(self._program, wf, trim=self._trim)
+# A map stage lazily applied per window; now the shared streaming
+# MappedStream (round 19), so stacked pipeline map stages form a plan-
+# routable chain: under TFS_PLAN each window runs ONE fused dispatch
+# (dead columns pruned, bucket pads proven) instead of one dispatch per
+# stage — bit-identical either way.
+from ..streaming.verbs import MappedStream as _MappedStream  # noqa: E402
 
 
 def _frame_windows_stream(frame: TensorFrame, window_rows: Optional[int]):

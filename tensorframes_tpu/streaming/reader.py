@@ -267,6 +267,26 @@ class StreamFrame:
     def group_by(self, *keys: str) -> StreamGroupedFrame:
         return StreamGroupedFrame(self, keys)
 
+    def map_blocks(self, fn, trim: bool = False, fetches=None,
+                   feed_dict=None, shapes=None, engine=None):
+        """Chain a lazily-applied per-window block map stage
+        (``streaming.verbs.MappedStream``).  Stacked stages form a
+        streamed map chain; under ``TFS_PLAN`` the chain routes through
+        plan construction per window (fusion + dead-column pruning)."""
+        from .verbs import MappedStream, _wrap
+
+        program = _wrap(fn, fetches, feed_dict, shapes)
+        return MappedStream(self, program, "map_blocks", trim, engine)
+
+    def map_rows(self, fn, fetches=None, feed_dict=None, shapes=None,
+                 engine=None):
+        """Chain a lazily-applied per-window row map stage (see
+        :meth:`map_blocks`)."""
+        from .verbs import MappedStream, _wrap
+
+        program = _wrap(fn, fetches, feed_dict, shapes)
+        return MappedStream(self, program, "map_rows", False, engine)
+
     def __repr__(self):
         rows = "?" if self.num_rows is None else self.num_rows
         return (

@@ -60,6 +60,77 @@ def _as_sink(sink):
     return sink
 
 
+class MappedStream(StreamFrame):
+    """A map stage lazily applied per window (the stage's Program — and
+    its hot executables — shared across windows).  Stacked instances
+    form a *streamed map chain*: ``stream.map_blocks(m1).map_rows(m2)``.
+
+    Round 19: under ``TFS_PLAN`` the OUTERMOST stage of a stack
+    collects the whole chain and routes each window through plan
+    construction (``planner.run_window_chain``) — adjacent stages fuse
+    into one dispatch per window, dead source columns are never staged,
+    and the ``analysis.rows_independent`` bucket pads apply — instead
+    of paying one dispatch (and one intermediate) per stage per window.
+    Eager per-stage dispatch stays the default and is bit-identical
+    (the fused chain applies each stage's own compiled entry)."""
+
+    def __init__(self, inner: StreamFrame, program, op: str, trim: bool,
+                 engine):
+        super().__init__(
+            source=lambda: iter(()),
+            window_rows=inner.window_rows or None,
+            num_blocks=inner._num_blocks,
+            num_rows=inner.num_rows if not trim else None,
+            reiterable=True,
+            label=f"{op}({inner._label})",
+        )
+        self._inner = inner
+        self._program = program
+        self._op = op
+        self._trim = trim
+        self._engine = engine
+
+    # chaining (`map_blocks`/`map_rows`) is inherited from StreamFrame —
+    # stacking just wraps another MappedStream around this one
+
+    # -- execution -----------------------------------------------------------
+
+    def _plan_chain(self):
+        """The maximal stack of default-engine map stages ending at
+        self (innermost first) plus the base stream they apply to, or
+        ``(None, None)`` when planning cannot take the stack (explicit
+        engines stay on their own dispatch surface)."""
+        steps = []
+        node = self
+        base = None
+        while isinstance(node, MappedStream):
+            if node._engine is not None:
+                return None, None
+            steps.append((node._op, node._program, node._trim))
+            base = node._inner
+            node = node._inner
+        steps.reverse()
+        return steps, base
+
+    def windows(self):
+        from ..ops import planner
+
+        if planner.planning_enabled():
+            steps, base = self._plan_chain()
+            if steps is not None and len(steps) >= 2:
+                for wf in base.windows():
+                    cancellation.checkpoint()
+                    yield planner.run_window_chain(wf, steps)
+                return
+        ex = _resolve(self._engine)
+        for wf in self._inner.windows():
+            cancellation.checkpoint()
+            if self._op == "map_rows":
+                yield ex.map_rows(self._program, wf)
+            else:
+                yield ex.map_blocks(self._program, wf, trim=self._trim)
+
+
 class _MergingSpan:
     """Span adapter for the streamed reduce verbs: the engine annotates
     the SAME span once per window (``fault_tolerance``, ``device_pool``,

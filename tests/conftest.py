@@ -114,6 +114,15 @@ os.environ.setdefault("TFS_BRIDGE_CLIENT_BUSY_RETRIES", "")
 # re-runs them with TFS_PLAN=1 exported, which wins over this
 # absence-default like every other tier's knobs.
 os.environ.setdefault("TFS_PLAN", "0")
+# Planner v2 (round 19): the cross-plan CSE registry's absence default
+# is ON — it only engages inside planned executions, which the main
+# suite opts into per test; the measured-calibration feedback and the
+# per-tenant HBM cache cap default OFF/uncapped.  The planner-v2 tests
+# drive all three via monkeypatch; the planner tier re-runs them with
+# the knobs exported live.
+os.environ.setdefault("TFS_PLAN_CSE", "")
+os.environ.setdefault("TFS_PLAN_CALIBRATE", "")
+os.environ.setdefault("TFS_CACHE_TENANT_BUDGET", "")
 
 # Relational verbs (round 18, tensorframes_tpu/relational/): shuffle,
 # windowed joins, and bridge pipelines stay at their inert defaults in

@@ -396,12 +396,13 @@ def test_pooled_planner_six_verbs_bit_identical(monkeypatch):
 
 
 def test_pooled_planner_h2d_drop_and_decision(monkeypatch):
-    """The round-14 evidence fence, pooled leg: a planned chain with a
-    twice-consumed intermediate stages STRICTLY fewer H2D bytes than the
-    eager chain (fusion skips the intermediate re-stage; the dead column
-    is never staged at all), the auto-cache serves the second consumer
-    from shards, and the plan span records the per-group dispatch
-    decision."""
+    """The round-14 evidence fence, pooled leg, updated for the round-19
+    fused terminal reduce: a planned chain consumed twice by terminal
+    reduces stages STRICTLY fewer H2D bytes than the eager chain — each
+    reduce now folds inside the chain dispatch (no materialized
+    intermediate at all), the ENTRY frame auto-caches on its second
+    consumption so the second fold reads resident shards, and the plan
+    span records the per-group dispatch decision."""
     monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
     # pin the cost-model threshold so the cold fused group deterministically
     # POOLS (host-assembled outputs -> the auto-cache story under test);
@@ -449,9 +450,12 @@ def test_pooled_planner_h2d_drop_and_decision(monkeypatch):
         d_planned["h2d_bytes_staged"] < d_eager["h2d_bytes_staged"]
     ), (d_planned, d_eager)
     # the dead column's bytes never moved: everything staged is accounted
-    # for by x (fused entry) + z (first reduce) + z (auto-cache build)
+    # for by x (fused entry, first fold) + x (entry auto-cache build) —
+    # the intermediate z is never assembled, never re-staged
     assert d_planned["h2d_bytes_staged"] <= 3 * col_bytes, d_planned
-    assert d_planned["plan_fused_dispatches"] == 1, d_planned
+    # round 19: BOTH reduces dispatch as fused chain+fold groups
+    assert d_planned["plan_fused_dispatches"] == 2, d_planned
+    assert d_planned["plan_fused_reduces"] == 2, d_planned
     assert d_planned["plan_cache_inserts"] == 1, d_planned
     assert d_planned["cache_shard_hits"] >= 1, d_planned
     plan_spans = [s for s in spans if s["verb"] == "plan"]
@@ -497,6 +501,11 @@ def test_pooled_planner_autocache_weakref_refunds_budget(monkeypatch):
     monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
     monkeypatch.setenv("TFS_PLAN_POOL_MIN_INTENSITY", "0")
     monkeypatch.setenv("TFS_HBM_BUDGET", "64M")
+    # settle cyclic garbage first: an earlier test's source-frame <->
+    # plan-root cycle (frame._tfs_lazy_root) releases its entry cache
+    # only at cyclic GC, which would otherwise land inside this test's
+    # window and sink the balance below the baseline
+    gc.collect()
     base = frame_cache.budget_bytes_resident()
     frame = _frame(n=256, nb=8)
     m1, m2 = _chain_programs()
@@ -544,6 +553,10 @@ def test_pooled_planner_cold_low_intensity_stays_serial(monkeypatch):
     column.  A re-run (warm executables) flips the decision to pool."""
     monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
     monkeypatch.delenv("TFS_PLAN_POOL_MIN_INTENSITY", raising=False)
+    # the decision layer is under test: without this, the identical
+    # re-derived chain below would be served by the round-19 CSE
+    # registry (its own tests live in test_planner_v2.py)
+    monkeypatch.setenv("TFS_PLAN_CSE", "0")
     frame = _frame(n=256, nb=8, d=8)
     # pure elementwise adds/muls: unambiguously below the default
     # 1 flop/byte threshold whatever the cost model charges for them.

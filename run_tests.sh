@@ -121,6 +121,22 @@ JAX_PLATFORMS=cpu \
   python -m pytest tests/test_relational.py -q
 rm -rf "$TFS_REL_TMP"
 
+# Recovery tier (round 20): durable execution — the crash-resume tests
+# re-run with TFS_JOURNAL_DIR LIVE, slow-marked cells included: the
+# process-kill harness SIGKILLs driver children (tests/_recovery_driver
+# .py) at sampled window/epoch boundaries across a seed×kill-point
+# matrix (all three crash phases: before the state write, between
+# state write and manifest replace, after the replace) and asserts the
+# resumed digests are byte-identical to uninterrupted runs.  The main
+# suite runs the same file minus the slow matrix (conftest pins the
+# journal knob off there; tests pass tmp_path journals).
+echo "== recovery tier (durable execution + process-kill matrix) =="
+TFS_REC_TMP="$(mktemp -d)"
+TFS_JOURNAL_DIR="$TFS_REC_TMP/journal" TFS_SPILL_DIR="$TFS_REC_TMP/spill" \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_recovery.py -q
+rm -rf "$TFS_REC_TMP"
+
 # Observability tier: the flight-recorder / histogram / metrics tests
 # re-run with TFS_TRACE=1 LIVE (the main suite pins it off and tests
 # drive the recorder via observability.enable_trace(); this tier proves

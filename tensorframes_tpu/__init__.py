@@ -55,6 +55,7 @@ from .schema import ColumnInfo, Schema, SchemaError
 from .shape import Shape, ShapeError, UNKNOWN
 from . import streaming
 from .streaming import scan_parquet
+from . import recovery
 from . import relational
 from .relational import join, join_frames, shuffle
 
@@ -111,6 +112,7 @@ __all__ = [
     "aggregate",
     "group_by",
     "iterate_epochs",
+    "recovery",
     "warm_plan",
     "map_blocks",
     "map_blocks_trimmed",

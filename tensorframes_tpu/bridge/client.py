@@ -91,7 +91,7 @@ def _read_grace_s(remaining_s: float) -> float:
 # duplicate admission slot (it waits for the original's outcome).
 _SAFE_METHODS = frozenset(
     {"ping", "schema", "health", "hello", "release", "metrics",
-     "attribution", "check"}
+     "attribution", "check", "job_status"}
 )
 
 
@@ -136,11 +136,29 @@ class Draining(BridgeError):
     """The server is draining for shutdown; route elsewhere."""
 
 
+class SessionLost(BridgeError):
+    """The session token no longer names server-side state — the
+    session TTL'd out, or the server RESTARTED (round 20).  Frames are
+    gone; durable jobs are not: reattach with a fresh session, re-upload
+    inputs, and re-issue durable requests with their ``job_id`` — the
+    journal resumes them from the last completed window (and a job that
+    already completed returns its journaled result without executing).
+    ``job_status(job_id)`` shows what survives."""
+
+
+class JobActive(BridgeError):
+    """A resume raced the original request: the job is still executing
+    server-side.  Never a concurrent duplicate — poll ``job_status``
+    (or just retry after it finishes)."""
+
+
 _CODED_ERRORS: Dict[str, type] = {
     "deadline_exceeded": DeadlineExceeded,
     "cancelled": Cancelled,
     "server_busy": ServerBusy,
     "draining": Draining,
+    "unknown_session": SessionLost,
+    "job_active": JobActive,
 }
 
 
@@ -638,6 +656,7 @@ class BridgeClient:
         stages: Sequence[Mapping[str, Any]],
         sink: Optional[Mapping[str, Any]] = None,
         deadline_ms: Optional[float] = None,
+        job_id: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Execute a whole source -> map -> join -> aggregate -> sink
         streaming pipeline server-side as ONE gated request (round 18).
@@ -655,17 +674,32 @@ class BridgeClient:
         frames (``frame_id``) always work.  The request's
         ``deadline_ms`` cancels the pipeline at the next window
         boundary; complete windows (and a parquet sink's finalized
-        file) survive."""
+        file) survive.  ``job_id`` makes the pipeline DURABLE: the
+        server journals every window boundary, a re-issued spec with
+        the same id resumes from the last completed window (after a
+        server restart too — catch :class:`SessionLost`, reattach,
+        re-upload frames, re-issue), and a completed job replays its
+        journaled result exactly once."""
         r = self.call(
             "pipeline",
             deadline_ms=deadline_ms,
             source=dict(source),
             stages=[dict(s) for s in stages],
             sink=dict(sink) if sink else None,
+            job_id=job_id,
         )
         if "frame_id" in r:
             r["frame"] = RemoteFrame(self, r["frame_id"], r["schema"])
         return r
+
+    def job_status(self, job_id: str) -> Dict[str, Any]:
+        """Status of a durable job (round 20, ungated): whether the
+        server's journal holds it, its completed-window boundary, and
+        whether its owner is alive (``running``) or dead
+        (``interrupted`` — resumable by re-issuing the request with the
+        same ``job_id``).  ``complete`` jobs return their journaled
+        result on resume without executing anything."""
+        return self.call("job_status", job_id=job_id)
 
     def create_frame(
         self,

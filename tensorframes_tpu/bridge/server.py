@@ -153,7 +153,13 @@ _GATED_METHODS = frozenset(
 # a client retry.  ``check`` (round 17) is DELIBERATELY ungated: its
 # whole point is that a tenant validates a program BEFORE burning an
 # admission slot on a request the verb would refuse.
-_UNGATED_METHODS = frozenset({"ping", "schema", "release", "check"})
+# ``job_status`` (round 20) is a pure journal read (no compile, no
+# dispatch, naturally idempotent), ungated for the same reason as
+# ``check``: a client deciding whether to resume must be able to ask
+# even when the server is saturated or draining.
+_UNGATED_METHODS = frozenset(
+    {"ping", "schema", "release", "check", "job_status"}
+)
 
 # how long a retried request waits for its still-running original
 # execution's outcome before giving up with ``retry_conflict``
@@ -597,7 +603,7 @@ class _Session:
                     f"operator allow the directory"
                 )
 
-    def pipeline(self, source=None, stages=None, sink=None):
+    def pipeline(self, source=None, stages=None, sink=None, job_id=None):
         """The gated ``pipeline`` RPC (round 18): execute a declarative
         source -> map -> join -> aggregate -> sink streaming pipeline
         (``relational/pipeline.py``) against this session's frames.
@@ -606,17 +612,36 @@ class _Session:
         per-window ledgers nest under this request's ledger, so the
         returned window attributions sum to the request's counters
         delta.  The result frame (aggregate / collect sinks) registers
-        in the session like any verb output."""
+        in the session like any verb output.
+
+        ``job_id`` (round 20) makes the pipeline durable: the journal
+        (``TFS_JOURNAL_DIR``) records every window boundary, so a
+        client that lost its server (``SessionLost``) reattaches,
+        re-registers its frames, and re-issues the SAME spec + job_id —
+        the server resumes from the last journaled window, and a job
+        that already completed returns its journaled result WITHOUT
+        executing (exactly-once, composing with — not relying on — the
+        per-session idempotency tokens, which cannot survive a server
+        restart).  A resume racing the still-running original is
+        refused with the typed ``job_active`` error, never executed
+        concurrently."""
+        from ..recovery import JobActive
         from ..relational import run_stream_pipeline
 
         self._check_pipeline_paths(source, sink)
-        out = run_stream_pipeline(
-            source,
-            stages=stages,
-            sink=sink,
-            frames=self.frames,
-            engine=self.engine,
-        )
+        try:
+            out = run_stream_pipeline(
+                source,
+                stages=stages,
+                sink=sink,
+                frames=self.frames,
+                engine=self.engine,
+                job_id=job_id,
+            )
+        except JobActive as exc:
+            raise BridgeServerError(
+                str(exc), code="job_active", retry_after_ms=250
+            ) from exc
         snaps = out["windows"]
         if len(snaps) > _PIPELINE_WINDOW_SNAPS:
             # bound the reply without breaking the exact-sum contract:
@@ -655,6 +680,8 @@ class _Session:
             "diagnostics": out["diagnostics"],
             "sink": out["sink"],
         }
+        if out.get("resumed"):
+            reply["resumed"] = True
         frame = out.get("frame")
         if frame is not None:
             fid = self.register(frame)
@@ -715,6 +742,16 @@ class _Session:
             how=how,
         )
         return {"diagnostics": [d.as_dict() for d in diags]}
+
+    def job_status(self, job_id: str = ""):
+        """Durable-job status (round 20, ungated): the journal's view
+        of ``job_id`` — present/running/interrupted/complete, completed
+        boundary, owner liveness.  The resume decision surface: a
+        client that caught ``SessionLost`` asks here what survived the
+        restart before re-issuing work."""
+        from .. import recovery
+
+        return recovery.job_status(str(job_id))
 
     def ping(self):
         return {"pong": True}
@@ -1331,6 +1368,39 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         for name, fn in self._gauge_providers.items():
             observability.register_gauge(name, fn)
         observability.maybe_start_metrics_server()
+        # durable-execution startup recovery (round 20): a restarted
+        # server inherits the journal's view of the world — reclaim
+        # dead processes' spill/journal leftovers (the orphan janitor)
+        # and inventory the interrupted jobs a reattaching client can
+        # resume (surfaced via health + the job_status RPC).  Never
+        # blocks or fails server start.
+        self._journal_recovery: Dict[str, Any] = {"configured": False}
+        try:
+            from .. import recovery as _recovery
+
+            if _recovery.configured():
+                arts = _recovery.janitor.scan()
+                reclaimed = _recovery.janitor.reclaim(artifacts=arts)
+                interrupted = sorted(
+                    _recovery.janitor.summary(arts)["interrupted_jobs"]
+                )
+                self._journal_recovery = {
+                    "configured": True,
+                    "interrupted_jobs": interrupted,
+                    "reclaimed_count": reclaimed["count"],
+                    "reclaimed_bytes": reclaimed["bytes"],
+                }
+                if interrupted:
+                    logger.info(
+                        "bridge: journal holds %d resumable job(s) "
+                        "from dead processes: %s",
+                        len(interrupted),
+                        interrupted,
+                    )
+        except Exception:  # noqa: BLE001 — recovery must not block start
+            logger.warning(
+                "bridge: journal startup recovery failed", exc_info=True
+            )
 
     def _admission_gauges(self) -> Dict[str, Any]:
         s = self.gate.snapshot()
@@ -1586,6 +1656,10 @@ class BridgeServer(socketserver.ThreadingTCPServer):
             # per-tenant window usage) for serving dashboards/balancers
             "coalescer": self.coalescer.snapshot(),
             "scheduler": self.scheduler.snapshot(),
+            # round 20: what the startup janitor found — whether a
+            # journal is configured, the resumable jobs dead processes
+            # left, and the stale bytes reclaimed at start
+            "journal": self._journal_recovery,
             "counters": {
                 k: c[k]
                 for k in (

@@ -53,6 +53,10 @@ Rules (each fires at most one diagnostic):
   partition's memory bound blows past total/partitions.  The advice
   names the key and ``TFS_SHUFFLE_PARTITIONS`` (evidence:
   ``relational.recent_shuffle_stats()``, injectable as ``shuffles=``).
+* **stale_artifacts** (round 20) — dead processes left reclaimable
+  spill/spool/journal bytes behind (the orphan janitor's scan), or
+  interrupted durable jobs await a resume; names the directories, the
+  bytes, and the ``job_id``s.
 * **cse_miss** (round 19) — the SAME subplan keeps re-executing across
   recent requests with no cross-plan sharing (evidence: the planner's
   plan-signature registry).  Usually the result frame is dropped
@@ -484,6 +488,52 @@ def _rule_cse_miss(c, plans) -> Optional[Dict[str, Any]]:
     )
 
 
+STALE_ARTIFACT_MIN_BYTES = 1 << 20  # ignore sub-MB crumbs
+
+
+def _rule_stale_artifacts(artifacts) -> Optional[Dict[str, Any]]:
+    """Dead processes left spill/spool/journal files behind (round 20,
+    the orphan janitor's scan): the bytes are reclaimable — nothing
+    live references them — and interrupted durable jobs are waiting to
+    be resumed.  Fires on >= 1 MB reclaimable OR any interrupted job."""
+    if not artifacts:
+        return None
+    nbytes = int(artifacts.get("reclaimable_bytes", 0))
+    interrupted = list(artifacts.get("interrupted_jobs") or ())
+    if nbytes < STALE_ARTIFACT_MIN_BYTES and not interrupted:
+        return None
+    dirs = [
+        d
+        for d in (artifacts.get("spill_dir"), artifacts.get("journal_dir"))
+        if d
+    ]
+    parts = []
+    if nbytes:
+        parts.append(
+            f"{artifacts.get('reclaimable_count', 0)} dead-process "
+            f"artifact(s), {nbytes} bytes reclaimable, under "
+            f"{' and '.join(dirs)}"
+        )
+    if interrupted:
+        parts.append(
+            f"{len(interrupted)} interrupted durable job(s) awaiting "
+            f"resume: {interrupted}"
+        )
+    return _diag(
+        "stale_artifacts",
+        "warn" if nbytes >= STALE_ARTIFACT_MIN_BYTES else "info",
+        "; ".join(parts),
+        dict(artifacts),
+        "TFS_JOURNAL_DIR",
+        "run tensorframes_tpu.recovery.janitor.reclaim() to delete the "
+        "dead-process spill/journal leftovers (a restarted "
+        "BridgeServer does this automatically at startup); resume "
+        "interrupted jobs by re-issuing their request with the same "
+        "job_id — the journal continues from the last completed "
+        "window",
+    )
+
+
 def _rule_indep_probe_churn(c) -> Optional[Dict[str, Any]]:
     falls = c.get("analysis_probe_fallbacks", 0)
     hits = c.get("analysis_static_hits", 0)
@@ -515,6 +565,7 @@ def doctor(
     tenants: Optional[Mapping[str, Mapping[str, Any]]] = None,
     shuffles: Optional[Sequence[Mapping[str, Any]]] = None,
     plans: Optional[Sequence[Mapping[str, Any]]] = None,
+    artifacts: Optional[Mapping[str, Any]] = None,
 ) -> List[Dict[str, Any]]:
     """Diagnose the process's (or the given snapshots') performance
     state.  Returns structured diagnostics, worst first — each names
@@ -552,6 +603,13 @@ def doctor(
             plans = recent_plan_stats()
         except Exception:  # noqa: BLE001 — diagnosis must never fail here
             plans = []
+    if artifacts is None:
+        try:  # the janitor's scan: two listdirs when roots configured
+            from .recovery import janitor
+
+            artifacts = janitor.summary()
+        except Exception:  # noqa: BLE001 — diagnosis must never fail here
+            artifacts = {}
     out: List[Dict[str, Any]] = []
     for rule in (
         lambda: _rule_shed_burn(c),
@@ -564,6 +622,7 @@ def doctor(
         lambda: _rule_coalesce_miss(c),
         lambda: _rule_shuffle_skew(shuffles),
         lambda: _rule_cse_miss(c, plans),
+        lambda: _rule_stale_artifacts(artifacts),
         lambda: _rule_indep_probe_churn(c),
         lambda: _rule_slow_tail(lat),
     ):

@@ -43,6 +43,17 @@ cannot be targeted (``method=hello`` parses but never fires: those paths
 must stay reliable so chaos tests can still attach, observe, and clean
 up around the faults they inject).
 
+Boundary kind (round 20, consumed by ``recovery/journal.py`` via
+:func:`maybe_kill_boundary`): ``proc_kill`` SIGKILLs the process at a
+durable job's journal boundary — selectors ``window=N`` (boundary
+index) and ``phase=pre|mid|post`` (before the state write / between
+state write and manifest replace / after the manifest replace; default
+``pre``), plus ``rate``/``seed``.  This is the process-death lever the
+crash-resume harness (``tests/test_recovery.py``, the ``recovery`` CI
+tier) drives from a parent process::
+
+    TFS_FAULT_INJECT="proc_kill:window=2:phase=mid"
+
 Selectors (all optional; a spec fires when every given selector
 matches):
 
@@ -72,6 +83,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import random
 import time
 from typing import List, Optional, Tuple
@@ -94,10 +106,22 @@ ENV_VAR = "TFS_FAULT_INJECT"
 # invocation of that method in the session, 0-based) target them.
 _ENGINE_KINDS = ("transient", "oom", "delay")
 _BRIDGE_KINDS = ("bridge_stall", "bridge_delay", "bridge_drop")
-_KINDS = _ENGINE_KINDS + _BRIDGE_KINDS
-_INT_KEYS = ("block", "device", "attempt", "minrows", "seed", "call")
+# boundary kinds (round 20) fire at the durable-job journal's
+# window/epoch boundary choke point (``recovery/journal.py``
+# ``JournalWriter.append``): ``proc_kill`` SIGKILLs THIS process — the
+# process-death lever the crash-resume harness drives, the analog of
+# Spark's kill-an-executor chaos (SURVEY.md §5).  Selectors:
+# ``window=N`` (the boundary index), ``phase=pre|mid|post`` (before the
+# state write / after the state write but before the manifest replace /
+# after the manifest replace — the three distinct crash cells of the
+# RESILIENCE.md process-death table; default ``pre``), plus
+# ``rate``/``seed`` with the same counter-free deterministic draw.
+_BOUNDARY_KINDS = ("proc_kill",)
+_KINDS = _ENGINE_KINDS + _BRIDGE_KINDS + _BOUNDARY_KINDS
+_INT_KEYS = ("block", "device", "attempt", "minrows", "seed", "call",
+             "window")
 _FLOAT_KEYS = ("rate", "ms")
-_STR_KEYS = ("method",)
+_STR_KEYS = ("method", "phase")
 
 
 class InjectedTransient(RuntimeError):
@@ -121,6 +145,8 @@ class FaultSpec:
     index: int = 0  # position in the spec list (decorrelates rate draws)
     method: Optional[str] = None  # bridge kinds: RPC method selector
     call: Optional[int] = None  # bridge kinds: per-session call index
+    window: Optional[int] = None  # boundary kinds: journal boundary index
+    phase: Optional[str] = None  # boundary kinds: pre|mid|post (default pre)
 
     def matches(
         self,
@@ -146,6 +172,24 @@ class FaultSpec:
         if self.rate is not None:
             draw = random.Random(
                 f"{self.seed}:{self.index}:{self.kind}:{block}:{attempt}"
+            ).random()
+            if draw >= self.rate:
+                return False
+        return True
+
+    def matches_boundary(self, window: int, phase: str) -> bool:
+        """Whether this (boundary-kind) spec fires at journal boundary
+        ``window`` in crash cell ``phase``.  An unset ``phase`` selector
+        means ``pre`` (the kill lands before any durability action, so
+        the whole window re-runs on resume — the default cell the
+        harness sweeps)."""
+        if self.window is not None and self.window != window:
+            return False
+        if (self.phase or "pre") != phase:
+            return False
+        if self.rate is not None:
+            draw = random.Random(
+                f"{self.seed}:{self.index}:{self.kind}:{window}"
             ).random()
             if draw >= self.rate:
                 return False
@@ -214,30 +258,35 @@ def _parse_one(raw: str, index: int) -> Optional[FaultSpec]:
             _warn_once(raw, f"selector {key}={val!r} is not numeric")
             return None
     # selectors are kind-scoped: an engine-kind spec with method=/call=
-    # (or a bridge-kind spec with block=/device=/attempt=/minrows=)
-    # would PARSE but never be consulted by the matching side — firing
-    # unscoped process-wide instead of where the selector pointed.
-    # Warn-and-drop, like every other malformed spec.
-    _BRIDGE_ONLY = ("method", "call")
-    _ENGINE_ONLY = ("block", "device", "attempt", "minrows")
-    if kind in _ENGINE_KINDS:
-        bad = [k for k in _BRIDGE_ONLY if k in fields]
+    # (or a bridge-kind spec with block=/device=/attempt=/minrows=, or
+    # either with window=/phase=) would PARSE but never be consulted by
+    # the matching side — firing unscoped process-wide instead of where
+    # the selector pointed.  Warn-and-drop, like every other malformed
+    # spec.
+    _SCOPED = {
+        "engine": ("block", "device", "attempt", "minrows"),
+        "bridge": ("method", "call"),
+        "boundary": ("window", "phase"),
+    }
+    scope = (
+        "engine"
+        if kind in _ENGINE_KINDS
+        else ("bridge" if kind in _BRIDGE_KINDS else "boundary")
+    )
+    for other, keys in _SCOPED.items():
+        if other == scope:
+            continue
+        bad = [k for k in keys if k in fields]
         if bad:
             _warn_once(
                 raw,
-                f"selector(s) {bad} only apply to bridge kinds "
-                f"{'/'.join(_BRIDGE_KINDS)}",
+                f"selector(s) {bad} only apply to {other} kinds, not "
+                f"{kind!r}",
             )
             return None
-    else:
-        bad = [k for k in _ENGINE_ONLY if k in fields]
-        if bad:
-            _warn_once(
-                raw,
-                f"selector(s) {bad} only apply to engine kinds "
-                f"{'/'.join(_ENGINE_KINDS)}",
-            )
-            return None
+    if fields.get("phase") not in (None, "pre", "mid", "post"):
+        _warn_once(raw, f"phase={fields['phase']!r} is not pre/mid/post")
+        return None
     return FaultSpec(**fields)
 
 
@@ -272,6 +321,35 @@ def active() -> bool:
 def bridge_active() -> bool:
     """Whether any bridge-level injection spec is live."""
     return any(s.kind in _BRIDGE_KINDS for s in specs())
+
+
+def boundary_active() -> bool:
+    """Whether any journal-boundary injection spec is live."""
+    return any(s.kind in _BOUNDARY_KINDS for s in specs())
+
+
+def maybe_kill_boundary(window: int, phase: str) -> None:
+    """The journal-boundary hook (``recovery/journal.py``): SIGKILL this
+    process for the first matching ``proc_kill`` spec — no cleanup, no
+    atexit, no flushed buffers, exactly the death the crash-resume
+    contract must survive.  A no-op (one truthiness check) when
+    ``TFS_FAULT_INJECT`` is unset."""
+    plan = specs()
+    if not plan:
+        return
+    for spec in plan:
+        if spec.kind not in _BOUNDARY_KINDS:
+            continue
+        if not spec.matches_boundary(window, phase):
+            continue
+        import signal
+
+        logger.warning(
+            "faults: proc_kill firing at boundary window=%d phase=%s",
+            window,
+            phase,
+        )
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def maybe_inject(

@@ -182,6 +182,16 @@ _counters: Dict[str, int] = {
     "shuffle_bytes_spilled": 0,
     "join_build_rows": 0,
     "join_probe_rows": 0,
+    # durable execution (round 20, tensorframes_tpu/recovery/): journal
+    # boundary appends + bytes (the write-ahead cost a bench leg can
+    # price), windows a resumed run SKIPPED from the journal vs re-ran
+    # (the at-most-one-window-re-executed evidence), jobs resumed from a
+    # journaled boundary, and zombie writes the fence rejected
+    "journal_appends": 0,
+    "journal_bytes_written": 0,
+    "journal_windows_skipped": 0,
+    "journal_resumes": 0,
+    "journal_fence_rejections": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
 
@@ -814,6 +824,40 @@ def note_join_probe_rows(n: int) -> None:
     _bump("join_probe_rows", int(n))
 
 
+def note_journal_append() -> None:
+    """One window/epoch boundary committed to a durable job's journal
+    (``recovery/journal.py``) — manifest atomically replaced."""
+    _bump("journal_appends")
+
+
+def note_journal_bytes(n: int) -> None:
+    """``n`` bytes of journal payload (state ``.npz`` files) written to
+    ``TFS_JOURNAL_DIR`` — the write-ahead overhead bench config 22
+    prices per window."""
+    _bump("journal_bytes_written", int(n))
+
+
+def note_journal_window_skipped() -> None:
+    """One already-journaled window a resumed run skipped at the table
+    level (never built, never dispatched) — paired with
+    ``stream_windows``, the proof that a resume re-executed at most the
+    one unfinished window."""
+    _bump("journal_windows_skipped")
+
+
+def note_journal_resume() -> None:
+    """One durable job adopted WITH journaled boundaries to resume from
+    (a fresh adoption of an empty job does not count)."""
+    _bump("journal_resumes")
+
+
+def note_journal_fence_rejection() -> None:
+    """One journal write refused because the writer's fence token was
+    superseded — a zombie process tried to write after a successor
+    adopted its job."""
+    _bump("journal_fence_rejections")
+
+
 def note_stream_window() -> None:
     """One streamed window materialised into host columns by the
     windowed reader (``streaming/reader.py``)."""
@@ -973,6 +1017,11 @@ def counters_delta(
             "shuffle_bytes_spilled",
             "join_build_rows",
             "join_probe_rows",
+            "journal_appends",
+            "journal_bytes_written",
+            "journal_windows_skipped",
+            "journal_resumes",
+            "journal_fence_rejections",
         )
     }
 

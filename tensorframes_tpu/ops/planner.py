@@ -351,6 +351,8 @@ class _FusedMeta:
         "stage_infos",
         "final_infos",
         "live_after",
+        # round 20: memoized calibration fingerprints per frame shape
+        "_calib_fps",
     )
 
 
@@ -550,6 +552,138 @@ _CALIBRATION: "collections.OrderedDict[Any, Dict[str, float]]" = (
 _CALIBRATION_CAP = 256
 _CALIBRATION_LOCK = threading.Lock()
 
+# -- cross-process persistence (round 20) ------------------------------------
+#
+# The in-memory table keys on live object ids — exact, but dead with the
+# process, so every restarted replica re-learned pool-vs-serial from
+# cold heuristics (the round-19 open item).  With BOTH knobs on
+# (TFS_PLAN_CALIBRATE + TFS_COMPILE_CACHE) measurements also persist to
+# ``<compile-cache>/tfs_calibration-v1.json`` under a STABLE chain
+# fingerprint (step kinds/trims + program input/fetch/feed names +
+# entry signature + fetches + rows + blocks — no ids), versioned and
+# atomically replaced.  Lookup order: live in-memory entry first (object
+# identity is stricter), persisted fingerprint second — so a fresh
+# process's FIRST request picks the measured winner instead of the
+# static intensity threshold.  A fingerprint collision can only steer a
+# heuristic (decision quality), never correctness: every dispatch kind
+# is bit-identical by contract.
+
+_CALIB_PERSIST_FORMAT = "tfs-calibration-v1"
+_calib_persist: Optional[Dict[str, Dict[str, float]]] = None
+_calib_persist_dir: Optional[str] = None
+
+
+def _calib_persist_path(cache_dir: str) -> str:
+    import os
+
+    return os.path.join(cache_dir, f"{_CALIB_PERSIST_FORMAT}.json")
+
+
+def _calib_persist_table() -> Optional[Dict[str, Dict[str, float]]]:
+    """The persisted fingerprint table (lock held by caller), lazily
+    loaded from the active compile-cache dir; None when no persistent
+    home is configured."""
+    global _calib_persist, _calib_persist_dir
+    from .. import compile_cache
+
+    d = compile_cache.cache_dir()
+    if not d:
+        return None
+    if _calib_persist is not None and _calib_persist_dir == d:
+        return _calib_persist
+    import json
+
+    table: Dict[str, Dict[str, float]] = {}
+    try:
+        with open(_calib_persist_path(d), "rb") as f:
+            doc = json.loads(f.read().decode())
+        if (
+            isinstance(doc, dict)
+            and doc.get("format") == _CALIB_PERSIST_FORMAT
+        ):
+            for fp, rec in (doc.get("entries") or {}).items():
+                table[str(fp)] = {
+                    k: float(v)
+                    for k, v in rec.items()
+                    if k in ("pool", "serial")
+                }
+    except (OSError, ValueError):
+        pass  # absent / torn / old format: start fresh
+    _calib_persist = table
+    _calib_persist_dir = d
+    return table
+
+
+def _calib_persist_save() -> None:
+    """Atomic-replace write of the persisted table (lock held by
+    caller).  The file is tiny (<= _CALIBRATION_CAP entries) — a write
+    per measured execution is noise next to the execution itself."""
+    import json
+    import os
+
+    if _calib_persist is None or not _calib_persist_dir:
+        return
+    # bound like the in-memory table: drop oldest-inserted overflow
+    while len(_calib_persist) > _CALIBRATION_CAP:
+        _calib_persist.pop(next(iter(_calib_persist)))
+    path = _calib_persist_path(_calib_persist_dir)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(
+                json.dumps(
+                    {
+                        "format": _CALIB_PERSIST_FORMAT,
+                        "entries": _calib_persist,
+                    }
+                ).encode()
+            )
+        os.replace(tmp, path)
+    except OSError:
+        _log.warning(
+            "planner: calibration persistence write failed", exc_info=True
+        )
+
+
+def _calib_fingerprint(meta: "_FusedMeta", frame: TensorFrame) -> str:
+    """A stable, cross-process fingerprint of the calibration workload:
+    everything ``_calib_key`` captures EXCEPT object identity.
+    Memoized on the meta (keyed by the frame-shape half) — the JSON +
+    sha256 walk must not run per planned dispatch."""
+    import hashlib
+    import json
+
+    memo_key = (frame.num_rows, frame.num_blocks, _entry_signature(frame))
+    memo = getattr(meta, "_calib_fps", None)
+    if memo is None:
+        memo = meta._calib_fps = {}
+    hit = memo.get(memo_key)
+    if hit is not None:
+        return hit
+
+    doc = {
+        "steps": [
+            {
+                "kind": st.kind,
+                "trim": bool(st.trim),
+                "inputs": list(st.program._input_names),
+                "fetches": st.program._declared_fetches or [],
+                "feed": sorted(st.program._feed.items()),
+            }
+            for st in meta.steps
+        ],
+        "entry": _entry_signature(frame),
+        "fetches": list(meta.fetches),
+        "rows": frame.num_rows,
+        "blocks": frame.num_blocks,
+    }
+    fp = hashlib.sha256(
+        json.dumps(doc, sort_keys=True, default=str).encode()
+    ).hexdigest()[:24]
+    if len(memo) < 64:
+        memo[memo_key] = fp
+    return fp
+
 
 def _calib_key(meta: "_FusedMeta", frame: TensorFrame) -> Tuple:
     # fetches distinguish a keep-pruned terminal chain from the full
@@ -605,6 +739,18 @@ def _calib_note(
         _CALIBRATION.move_to_end(key)
         while len(_CALIBRATION) > _CALIBRATION_CAP:
             _CALIBRATION.popitem(last=False)
+        # cross-process persistence (compile-cache dir configured):
+        # fold the measurement into the fingerprint table too, so a
+        # restarted process starts from measured history
+        persisted = _calib_persist_table()
+        if persisted is not None:
+            fp = _calib_fingerprint(meta, frame)
+            prec = persisted.setdefault(fp, {})
+            if float(rows_per_s) > prec.get(dispatch, 0.0):
+                # write the (tiny) file only when the best measurement
+                # actually moved — steady state pays zero file writes
+                prec[dispatch] = float(rows_per_s)
+                _calib_persist_save()
 
 
 def _calib_lookup(
@@ -613,9 +759,34 @@ def _calib_lookup(
     key = _calib_key(meta, frame)
     with _CALIBRATION_LOCK:
         rec = _calib_entry(key, meta)
-        if rec is None:
-            return None
-        return {k: v for k, v in rec.items() if not k.startswith("_")}
+        live = (
+            {k: v for k, v in rec.items() if not k.startswith("_")}
+            if rec is not None
+            else {}
+        )
+        persisted = _calib_persist_table()
+        if persisted is not None:
+            # persisted history fills what this process has not yet
+            # measured (the post-restart first request); a live
+            # measurement of the same kind wins — it is the fresher
+            # observation of THIS process's conditions
+            for k, v in persisted.get(
+                _calib_fingerprint(meta, frame), {}
+            ).items():
+                live.setdefault(k, float(v))
+        return live or None
+
+
+def reset_calibration(persisted: bool = False) -> None:
+    """Clear the in-memory calibration table (tests/bench legs);
+    ``persisted=True`` also forgets the loaded fingerprint table so the
+    next lookup re-reads the compile-cache file from disk."""
+    global _calib_persist, _calib_persist_dir
+    with _CALIBRATION_LOCK:
+        _CALIBRATION.clear()
+        if persisted:
+            _calib_persist = None
+            _calib_persist_dir = None
 
 
 def calibration_snapshot() -> List[Dict[str, Any]]:
@@ -2536,7 +2707,9 @@ def _start_epoch_primer(root: "LazyFrame"):
     return t
 
 
-def iterate_epochs(frame, step, epochs: int) -> List[Any]:
+def iterate_epochs(
+    frame, step, epochs: int, job_id: Optional[str] = None
+) -> List[Any]:
     """Planner-aware multi-epoch driver (``tfs.iterate_epochs``): run
     ``step(lazy_frame, epoch)`` ``epochs`` times over one shared plan
     root.
@@ -2556,7 +2729,16 @@ def iterate_epochs(frame, step, epochs: int) -> List[Any]:
     index; derive chains and reduce/aggregate off it exactly as in a
     hand-written loop (params may change between epochs via
     ``update_params`` — the plan re-executes, the executables stay
-    warm).  Returns the per-epoch results."""
+    warm).  Returns the per-epoch results.
+
+    ``job_id`` (round 20) makes the loop durable: each epoch's result
+    (npz-serializable pytrees — arrays, scalars, nested containers) is
+    journaled at the epoch boundary, a resumed loop replays journaled
+    epochs' results WITHOUT running ``step`` for them, and a completed
+    loop returns its journaled result list exactly once.  ``step`` must
+    derive any carried state (params it updates) from the journaled
+    results, not from process-local mutation, for the resumed epochs to
+    be bit-identical — the epoch-matrix test pins exactly this shape."""
     if epochs < 1:
         raise ValidationError("iterate_epochs: epochs must be >= 1")
     if isinstance(frame, LazyFrame):
@@ -2567,17 +2749,49 @@ def iterate_epochs(frame, step, epochs: int) -> List[Any]:
         raise ValidationError(
             "iterate_epochs: takes a TensorFrame or LazyFrame"
         )
+    writer = None
+    start_epoch = 0
+    results: List[Any] = []
+    if job_id is not None:
+        from .. import recovery
+
+        writer = recovery.adopt(
+            job_id,
+            "iterate_epochs",
+            recovery.job_fingerprint("iterate_epochs", epochs=epochs),
+        )
+        # completed AND interrupted loops replay journaled epochs from
+        # their per-boundary states (kept past complete for this); a
+        # torn-state raise here must release the in-process job slot
+        with recovery.durable.closing_on_error(writer):
+            start_epoch = min(writer.boundary, epochs)
+            for e in range(start_epoch):
+                results.append(
+                    recovery.unpack_tree(
+                        writer.load_state(e) or {}, writer.extras()[e]
+                    )
+                )
+                # the epoch analog of a skipped stream window:
+                # journaled, replayed, never re-executed
+                observability.note_journal_window_skipped()
+        if writer.completed:
+            writer.close()
+            return results
     if epochs >= 2 and root._materialized is not None:
         # declare the loop's >= 2 consumptions up front: the entry
         # auto-cache triggers on the FIRST consumption instead of
         # waiting to observe a second one
         root._mat_uses = max(root._mat_uses, 1)
-    results: List[Any] = []
     primer = None
     try:
-        for e in range(epochs):
+        for e in range(start_epoch, epochs):
             cancellation.checkpoint()  # epoch boundary
             results.append(step(root, e))
+            if writer is not None:
+                from .. import recovery
+
+                arrays, extra = recovery.pack_tree(results[-1])
+                writer.append(arrays=arrays, extra=extra)
             # the primer runs CONCURRENTLY with the next epoch (the
             # overlap is the point: re-staging evicted shards rides
             # under epoch N+1's host work; the dispatch path tolerates
@@ -2586,9 +2800,18 @@ def iterate_epochs(frame, step, epochs: int) -> List[Any]:
             # most one primer is in flight.
             if e + 1 < epochs and (primer is None or not primer.is_alive()):
                 primer = _start_epoch_primer(root)
+    except BaseException:
+        if writer is not None:
+            writer.close()  # stays resumable from the journal
+        raise
     finally:
         if primer is not None:
             primer.join()
+    if writer is not None:
+        from .. import recovery
+
+        with recovery.durable.closing_on_error(writer):
+            writer.complete(keep_states=True)
     return results
 
 

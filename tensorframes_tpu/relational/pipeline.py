@@ -47,6 +47,7 @@ from ..streaming import from_batches, scan_parquet
 from ..streaming.reader import StreamFrame
 from ..streaming.sink import CollectSink, ParquetSink
 from ..streaming.verbs import _concat_partial_frames
+from ..recovery.durable import closing_on_error as _closing_on_error
 # the function, not the submodule: the package re-exports `join` (the
 # callable) over the submodule name, so a `from . import join` here
 # would resolve to whichever won the package-init race
@@ -243,6 +244,7 @@ def run_stream_pipeline(
     engine=None,
     tenant: Optional[str] = None,
     check: bool = True,
+    job_id: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Execute a pipeline spec window by window.  Returns::
 
@@ -251,7 +253,16 @@ def run_stream_pipeline(
          "rows": int,                   # rows emitted to the terminal
          "windows": [ledger snapshots], # one per window (PR 10)
          "diagnostics": [...]}          # the pre-dispatch check result
-    """
+
+    ``job_id`` (round 20) makes the pipeline durable: every completed
+    window journals its boundary (and, for frame/collect/aggregate
+    terminals, its output state) under ``TFS_JOURNAL_DIR``, parquet
+    sinks become per-window part directories, and a re-issued spec with
+    the same ``job_id`` resumes from the journaled boundary — or, when
+    the job already completed, returns the journaled result without
+    executing a single window (exactly-once).  The returned per-window
+    ledger snapshots cover exactly the windows THIS run executed, so
+    their counters still sum to the request's attribution ledger."""
     stages = list(stages or ())
     diags = check_pipeline(source, stages, frames) if check else []
     errors = [d for d in diags if d.severity == "error"]
@@ -263,68 +274,151 @@ def run_stream_pipeline(
             code=errors[0].code,
         )
 
-    ex = _resolve(engine)
-    stream = _build_source(source, frames)
+    writer = None
+    if job_id is not None:
+        from .. import recovery
 
-    agg_stage = None
-    if stages and stages[-1].get("op") == "aggregate":
-        agg_stage = stages[-1]
-        stages = stages[:-1]
-
-    cur = stream
-    for si, stage in enumerate(stages):
-        op = stage.get("op")
-        if op in _MAP_OPS:
-            program = _stage_program(stage, f"pipeline:stage{si}")
-            cur = _MappedStream(
-                cur, program, op, bool(stage.get("trim")), engine
-            )
-        elif op == "join":
-            build = stage.get("build_frame")
-            if build is None:
-                fid = stage.get("build_frame_id")
-                if frames is None or fid not in frames:
-                    raise ValidationError(
-                        f"pipeline: join stage {si} names unknown "
-                        f"build_frame_id {fid!r}"
-                    )
-                build = frames[fid]
-            cur = _join_call(
-                cur,
-                build,
-                on=stage["on"],
-                how=stage.get("how", "inner"),
-                strategy=stage.get("strategy", "auto"),
-                partitions=stage.get("partitions"),
-            )
-        else:
-            raise ValidationError(
-                f"pipeline: unknown (or misplaced) op {op!r} at stage "
-                f"{si}"
-            )
-
-    agg_program = agg_keys = None
-    if agg_stage is not None:
-        agg_program = _stage_program(agg_stage, "pipeline:aggregate")
-        agg_keys = list(agg_stage.get("keys") or ())
-        if not agg_keys:
-            raise ValidationError("pipeline: aggregate needs keys=[...]")
-
-    sink = dict(sink or {"kind": "frame"})
-    kind = sink.get("kind", "frame")
-    sink_obj = None
-    if agg_stage is None:
-        if kind == "parquet":
-            sink_obj = ParquetSink(sink["path"])
-        elif kind in ("frame", "collect"):
-            sink_obj = CollectSink(limit_rows=sink.get("limit_rows"))
-        else:
-            raise ValidationError(f"pipeline: unknown sink kind {kind!r}")
-    elif kind == "parquet":
-        raise ValidationError(
-            "pipeline: an aggregate-terminal pipeline returns a frame; "
-            "write it with to_parquet afterwards"
+        sink_kind = (
+            dict(sink).get("kind", "frame")
+            if isinstance(sink, Mapping)
+            else "frame"
         )
+        writer = recovery.adopt(
+            job_id,
+            "pipeline",
+            recovery.job_fingerprint(
+                "pipeline",
+                ops=[s.get("op") for s in stages],
+                sink=sink_kind,
+            ),
+        )
+        if writer.completed:
+            res_extra = writer.result_extra or {}
+            result: Dict[str, Any] = {
+                "rows": int(res_extra.get("rows", 0)),
+                "windows": [],
+                "diagnostics": [d.as_dict() for d in diags],
+                "frame": None,
+                "sink": res_extra.get("sink"),
+                "resumed": True,
+            }
+            arrays = writer.load_result()
+            if arrays is not None:
+                result["frame"] = recovery.unpack_blocks(
+                    arrays, res_extra
+                )
+            writer.close()
+            return result
+
+    # everything from source construction to the resume replay can
+    # refuse (bad spec, sort-merge stage, torn state): the job slot
+    # must be released on ANY of those raises
+    with _closing_on_error(writer):
+        ex = _resolve(engine)
+        stream = _build_source(source, frames)
+
+        agg_stage = None
+        if stages and stages[-1].get("op") == "aggregate":
+            agg_stage = stages[-1]
+            stages = stages[:-1]
+
+        cur = stream
+        for si, stage in enumerate(stages):
+            op = stage.get("op")
+            if op in _MAP_OPS:
+                program = _stage_program(stage, f"pipeline:stage{si}")
+                cur = _MappedStream(
+                    cur, program, op, bool(stage.get("trim")), engine
+                )
+            elif op == "join":
+                build = stage.get("build_frame")
+                if build is None:
+                    fid = stage.get("build_frame_id")
+                    if frames is None or fid not in frames:
+                        raise ValidationError(
+                            f"pipeline: join stage {si} names unknown "
+                            f"build_frame_id {fid!r}"
+                        )
+                    build = frames[fid]
+                cur = _join_call(
+                    cur,
+                    build,
+                    on=stage["on"],
+                    how=stage.get("how", "inner"),
+                    strategy=stage.get("strategy", "auto"),
+                    partitions=stage.get("partitions"),
+                )
+            else:
+                raise ValidationError(
+                    f"pipeline: unknown (or misplaced) op {op!r} at stage "
+                    f"{si}"
+                )
+
+        agg_program = agg_keys = None
+        if agg_stage is not None:
+            agg_program = _stage_program(agg_stage, "pipeline:aggregate")
+            agg_keys = list(agg_stage.get("keys") or ())
+            if not agg_keys:
+                raise ValidationError("pipeline: aggregate needs keys=[...]")
+
+        sink = dict(sink or {"kind": "frame"})
+        kind = sink.get("kind", "frame")
+        sink_obj = None
+        if agg_stage is None:
+            if kind == "parquet":
+                if writer is not None:
+                    from ..streaming.sink import DurablePartSink
+
+                    sink_obj = DurablePartSink(sink["path"])
+                else:
+                    sink_obj = ParquetSink(sink["path"])
+            elif kind in ("frame", "collect"):
+                sink_obj = CollectSink(limit_rows=sink.get("limit_rows"))
+            else:
+                raise ValidationError(f"pipeline: unknown sink kind {kind!r}")
+        elif kind == "parquet":
+            raise ValidationError(
+                "pipeline: an aggregate-terminal pipeline returns a frame; "
+                "write it with to_parquet afterwards"
+            )
+
+        acc: Optional[TensorFrame] = None
+        start_window = 0
+        prior_rows = 0
+        if writer is not None:
+            from .. import recovery
+            from ..streaming.verbs import _load_journaled_acc
+
+            # refuses sort-merge joins and one-shot sources up front — a
+            # durable pipeline must be resumable window-for-window
+            recovery.check_durable_source(cur)
+            start_window = writer.boundary
+            if not start_window and kind == "parquet" and (
+                agg_stage is None
+            ):
+                # fresh job into a reused directory: stale parts out
+                sink_obj.discard_existing()
+            if start_window:
+                prior_rows = sum(
+                    int(e.get("rows", 0)) for e in writer.extras()
+                )
+                if agg_stage is not None:
+                    acc = _load_journaled_acc(writer)
+                elif kind == "parquet":
+                    sink_obj.start_at(start_window, prior_rows)
+                else:
+                    # frame/collect: replay the journaled output windows
+                    # into the sink (byte-exact .npz round trip), so the
+                    # assembled frame equals the uninterrupted run's
+                    for wi in range(start_window):
+                        st = writer.load_state(wi)
+                        if st is not None:
+                            sink_obj.write(
+                                recovery.unpack_blocks(
+                                    st, writer.extras()[wi]
+                                )
+                            )
+                recovery.skip_stream(cur, start_window)
 
     # -- the window loop: per-window ledgers nested under the active
     # request's (the bridge handler's) ledger, so per-window counters
@@ -337,10 +431,9 @@ def run_stream_pipeline(
     )
     tenant = tenant or (parent.tenant if parent is not None else None)
     window_snaps: List[Dict[str, Any]] = []
-    acc: Optional[TensorFrame] = None
-    rows = 0
+    rows = prior_rows
     it = iter(cur.windows())
-    i = 0
+    i = start_window
     t_pipe = observability.trace_now()
     try:
         while True:
@@ -378,6 +471,29 @@ def run_stream_pipeline(
                     else:
                         sink_obj.write(wf)
                     rows += wf.num_rows
+                    if writer is not None:
+                        # the boundary commit: terminal output is
+                        # durable (part file / journaled state), now
+                        # the manifest records the window as done
+                        from .. import recovery
+
+                        if agg_program is not None:
+                            arrays, pextra = recovery.pack_blocks(acc)
+                            writer.append(
+                                arrays=arrays,
+                                extra={**pextra, "rows": wf.num_rows},
+                                replace_state=True,
+                            )
+                        elif kind == "parquet":
+                            writer.append(
+                                extra={"rows": wf.num_rows}
+                            )
+                        else:
+                            arrays, pextra = recovery.pack_blocks(wf)
+                            writer.append(
+                                arrays=arrays,
+                                extra={**pextra, "rows": wf.num_rows},
+                            )
             finally:
                 observability.deactivate_request(token)
                 led.finish()
@@ -402,6 +518,8 @@ def run_stream_pipeline(
                     "pipeline: sink close failed while handling an "
                     "earlier error", exc_info=True,
                 )
+        if writer is not None:
+            writer.close()  # stays resumable from the journal
         raise
     observability.trace_complete(
         "pipeline", "relational", t_pipe, windows=i, rows=rows,
@@ -420,4 +538,18 @@ def run_stream_pipeline(
         result["sink"] = sink_obj.close()
     else:
         result["frame"] = sink_obj.close()
+    if writer is not None:
+        from .. import recovery
+
+        with _closing_on_error(writer):
+            if result["frame"] is not None:
+                arrays, pextra = recovery.pack_blocks(result["frame"])
+                writer.complete(
+                    result_arrays=arrays,
+                    result_extra={**pextra, "rows": rows},
+                )
+            else:
+                writer.complete(
+                    result_extra={"rows": rows, "sink": result["sink"]}
+                )
     return result

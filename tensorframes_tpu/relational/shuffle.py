@@ -60,6 +60,7 @@ from ..ops.validation import ValidationError
 from ..schema import ColumnInfo
 from ..streaming import spill as _spill
 from ..streaming.reader import StreamFrame
+from ..recovery.durable import closing_on_error as _closing_on_error
 
 logger = logging.getLogger("tensorframes_tpu.relational")
 
@@ -307,6 +308,11 @@ class PartitionStream(StreamFrame):
     def windows(self):
         sh = self._shuffled
         runs = sh.run_keys[self._pid]
+        if self._skip_windows:
+            # durable resume: a run is one window — skip by index
+            for _ in runs[: self._skip_windows]:
+                observability.note_journal_window_skipped()
+            runs = runs[self._skip_windows :]
 
         def stage_frame(i):
             arrays = sh.spill.get(runs[i])
@@ -338,8 +344,20 @@ class _ChainedStream(StreamFrame):
         self._shuffled = shuffled
 
     def windows(self):
+        skip = self._skip_windows
         for p in range(self._shuffled.partitions):
-            yield from self._shuffled.partition(p).windows()
+            ps = self._shuffled.partition(p)
+            n = len(self._shuffled.run_keys[p])
+            if skip >= n:
+                # whole partition already journaled: count, never read
+                for _ in range(n):
+                    observability.note_journal_window_skipped()
+                skip -= n
+                continue
+            if skip:
+                ps._skip_windows = skip
+                skip = 0
+            yield from ps.windows()
 
 
 class ShuffledFrame:
@@ -427,12 +445,60 @@ def _windows_of(obj) -> Tuple[Any, int, str]:
     )
 
 
+def _infos_to_json(infos: Sequence[ColumnInfo]) -> List[Dict[str, Any]]:
+    return [
+        {
+            "name": i.name,
+            "st": i.scalar_type.name,
+            "cell": [int(d) for d in i.cell_shape],
+        }
+        for i in infos
+    ]
+
+
+def _infos_from_json(doc: Sequence[Dict[str, Any]]) -> List[ColumnInfo]:
+    from .. import dtypes
+    from ..shape import UNKNOWN, Shape
+
+    return [
+        ColumnInfo(
+            d["name"],
+            dtypes.by_name(d["st"]),
+            Shape((1,) + tuple(int(x) for x in d["cell"])).with_lead(
+                UNKNOWN
+            ),
+        )
+        for d in doc
+    ]
+
+
+def _rebuild_shuffled(
+    writer, spill, window_rows: int, num_blocks: int
+) -> ShuffledFrame:
+    """A completed durable shuffle, rebuilt whole from its journaled
+    result — run files verified present, nothing re-keyed."""
+    res = writer.result_extra
+    return ShuffledFrame(
+        res["key"],
+        int(res["partitions"]),
+        spill,
+        _infos_from_json(res["schema"]),
+        dict(res["kinds"]),
+        [list(r) for r in res["run_keys"]],
+        [int(r) for r in res["partition_rows"]],
+        int(res.get("window_rows") or window_rows),
+        int(res.get("num_blocks") or num_blocks),
+        res.get("label") or "shuffle(resumed)",
+    )
+
+
 def shuffle(
     stream,
     key: str,
     partitions: Optional[int] = None,
     spill=None,
     label: Optional[str] = None,
+    job_id: Optional[str] = None,
 ) -> ShuffledFrame:
     """Hash-partition ``stream``'s rows by ``key`` into
     ``partitions`` spill-run sets and return the re-keyed
@@ -441,7 +507,16 @@ def shuffle(
 
     ``spill`` defaults to the ``TFS_SPILL_DIR`` store; shuffling with no
     spill root configured is an error (the runs have no other home).
-    """
+
+    ``job_id`` (round 20) makes the shuffle DURABLE: runs live under
+    the job's ``TFS_JOURNAL_DIR`` directory (out of the janitor's
+    dead-pid spill sweep), every window boundary journals the runs it
+    wrote, and a process death resumes from the last journaled window —
+    re-partitioning only the unfinished window, runs byte-identical to
+    an uninterrupted shuffle (the hash is process-salt-free by design).
+    The atomic-discard-on-cancel contract narrows accordingly: only the
+    UNJOURNALED window's runs are discarded; journaled runs are the
+    resume state."""
     P = (
         int(partitions)
         if partitions is not None
@@ -449,24 +524,64 @@ def shuffle(
     )
     if P < 1:
         raise ValidationError(f"partitions must be >= 1, got {partitions}")
-    if spill is None:
-        spill = _spill.store_if_configured()
-    if spill is None:
-        raise ValidationError(
-            f"shuffle needs a disk home for its partition runs; set "
-            f"{_spill.ENV_SPILL_DIR} (or pass spill=) before re-keying"
+    writer = None
+    if job_id is not None:
+        from .. import recovery
+
+        writer = recovery.adopt(
+            job_id,
+            "shuffle",
+            recovery.job_fingerprint("shuffle", key=key, partitions=P),
         )
-    windows, window_rows, src_label = _windows_of(stream)
-    tag = _next_tag()
-    run_keys: List[List[str]] = [[] for _ in range(P)]
-    partition_rows = [0] * P
-    infos: Optional[List[ColumnInfo]] = None
-    kinds: Optional[Dict[str, str]] = None
+        spill = _spill.SpillStore(writer.dir)
+        num_blocks = getattr(stream, "_num_blocks", 1)
+        win_hint = getattr(stream, "window_rows", 0) or 0
+        if writer.completed:
+            out = _rebuild_shuffled(writer, spill, win_hint, num_blocks)
+            writer.close()
+            return out
+        if isinstance(stream, StreamFrame):
+            recovery.check_durable_source(stream)
+    with _closing_on_error(writer):
+        if spill is None:
+            spill = _spill.store_if_configured()
+        if spill is None:
+            raise ValidationError(
+                f"shuffle needs a disk home for its partition runs; set "
+                f"{_spill.ENV_SPILL_DIR} (or pass spill=) before re-keying"
+            )
+        tag = _next_tag()
+        run_keys: List[List[str]] = [[] for _ in range(P)]
+        partition_rows = [0] * P
+        infos: Optional[List[ColumnInfo]] = None
+        kinds: Optional[Dict[str, str]] = None
+        start_window = 0
+        if writer is not None and writer.boundary:
+            # resume: re-adopt the journaled windows' runs, skip their
+            # ingestion entirely, continue partitioning at the boundary
+            for extra in writer.extras():
+                for p_str, keys in (extra.get("runs") or {}).items():
+                    run_keys[int(p_str)].extend(keys)
+                for p_str, n in (extra.get("prows") or {}).items():
+                    partition_rows[int(p_str)] += int(n)
+                if infos is None and extra.get("schema"):
+                    infos = _infos_from_json(extra["schema"])
+                    kinds = dict(extra["kinds"])
+            start_window = writer.boundary
+            if isinstance(stream, StreamFrame):
+                from .. import recovery
+
+                recovery.skip_stream(stream, start_window)
+        windows, window_rows, src_label = _windows_of(stream)
+        if start_window and not isinstance(stream, StreamFrame):
+            # a materialized frame is ONE window; journaled means done
+            windows = iter(())
     written: List[str] = []
+    window_written: List[str] = []
     completed = False
     t_shuffle = observability.trace_now()
     try:
-        for wi, wf in enumerate(windows):
+        for wi, wf in enumerate(windows, start=start_window):
             # window boundary = cancellation checkpoint (PR 6): a
             # deadline that passes mid-shuffle stops BEFORE the next
             # window partitions, and the runs written so far are
@@ -478,6 +593,9 @@ def shuffle(
                 kinds = _column_kinds(wf)
                 infos = [c.info for c in wf.columns]
             pids = partition_ids(np.asarray(kcol.data), P)
+            window_written = []
+            window_runs: Dict[str, List[str]] = {}
+            window_prows: Dict[str, int] = {}
             for p in range(P):
                 rows = np.nonzero(pids == p)[0]
                 if len(rows) == 0:
@@ -485,10 +603,24 @@ def shuffle(
                 run_key = f"{tag}-p{p:03d}-r{len(run_keys[p]):06d}"
                 nbytes = spill.put(run_key, _encode_run(wf, rows, kinds))
                 written.append(run_key)
+                window_written.append(run_key)
                 run_keys[p].append(run_key)
                 partition_rows[p] += len(rows)
+                window_runs.setdefault(str(p), []).append(run_key)
+                window_prows[str(p)] = len(rows)
                 observability.note_shuffle_partition_written()
                 observability.note_shuffle_bytes_spilled(nbytes)
+            if writer is not None:
+                extra = {
+                    "runs": window_runs,
+                    "prows": window_prows,
+                    "rows": wf.num_rows,
+                }
+                if wi == start_window and start_window == 0:
+                    extra["schema"] = _infos_to_json(infos)
+                    extra["kinds"] = kinds
+                writer.append(extra=extra)
+                window_written = []
             observability.trace_complete(
                 f"shuffle window {wi}", "relational", t_win,
                 window=wi, rows=wf.num_rows, key=key,
@@ -496,19 +628,45 @@ def shuffle(
         completed = True
     finally:
         if not completed:
-            # atomic discard: a cancelled/failed shuffle leaves NO runs
-            # behind — a consumer can never observe half a re-key
-            for k in written:
-                spill.delete(k)
+            if writer is not None:
+                # durable: journaled runs ARE the resume state — discard
+                # only the unfinished window's (unjournaled) runs
+                for k in window_written:
+                    spill.delete(k)
+                writer.close()
+            else:
+                # atomic discard: a cancelled/failed shuffle leaves NO
+                # runs behind — a consumer can never observe half a
+                # re-key
+                for k in written:
+                    spill.delete(k)
     observability.trace_complete(
         "shuffle", "relational", t_shuffle,
         key=key, partitions=P, rows=sum(partition_rows),
     )
     _note_shuffle_stats(key, partition_rows)
-    if infos is None:
-        raise ValidationError("shuffle: cannot re-key an empty stream")
+    with _closing_on_error(writer):
+        if infos is None:
+            raise ValidationError(
+                "shuffle: cannot re-key an empty stream"
+            )
     out_label = label or f"shuffle({src_label})"
     num_blocks = getattr(stream, "_num_blocks", 1)
+    if writer is not None:
+        with _closing_on_error(writer):
+            writer.complete(
+                result_extra={
+                    "key": key,
+                    "partitions": P,
+                    "run_keys": run_keys,
+                    "partition_rows": partition_rows,
+                    "window_rows": window_rows,
+                    "num_blocks": num_blocks,
+                    "label": out_label,
+                    "schema": _infos_to_json(infos),
+                    "kinds": kinds,
+                }
+            )
     return ShuffledFrame(
         key, P, spill, infos, kinds, run_keys, partition_rows,
         window_rows, num_blocks, out_label,

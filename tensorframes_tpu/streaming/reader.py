@@ -251,6 +251,11 @@ class StreamFrame:
         self._consumed = False
         self._spool_dir: Optional[str] = None
         self._effective_rows: Optional[int] = None
+        # durable resume (round 20, tensorframes_tpu/recovery/): windows
+        # to discard at the TABLE level before the first frame builds —
+        # set via recovery.skip_stream, counted per skipped window in
+        # ``journal_windows_skipped`` (never ``stream_windows``)
+        self._skip_windows = 0
 
     # -- metadata ------------------------------------------------------------
 
@@ -426,6 +431,17 @@ class StreamFrame:
             else None
         )
         tables = self._window_tables(self._source())
+        if self._skip_windows:
+            if spool is not None:
+                # a one-shot source's spool must hold EVERY window to be
+                # a valid replay; skipping while spooling would tear it
+                # (durable jobs refuse one-shot sources up front —
+                # recovery.check_durable_source — this is the backstop)
+                raise ValidationError(
+                    f"StreamFrame[{self._label}]: cannot skip windows "
+                    f"while spooling a one-shot source"
+                )
+            tables = self._skip_tables(tables, self._skip_windows)
 
         def stage_frame(i):
             tbl = next(tables)  # StopIteration ends the iteration
@@ -452,6 +468,19 @@ class StreamFrame:
                 else:
                     spool.discard()
 
+    def _skip_tables(self, tables, n: int):
+        """Discard the first ``n`` window tables — the resume fast-path:
+        the source is still decoded (windowing needs the byte stream)
+        but no TensorFrame is built, nothing stages, nothing dispatches,
+        and the host-byte gauge never sees the skipped windows."""
+        skipped = 0
+        for tbl in tables:
+            if skipped < n:
+                skipped += 1
+                observability.note_journal_window_skipped()
+                continue
+            yield tbl
+
     def _spooled_windows(self) -> Iterator[TensorFrame]:
         """Replay pass over the spooled part files — one file per
         original window, read (and counted) one window at a time."""
@@ -462,6 +491,10 @@ class StreamFrame:
             for n in sorted(os.listdir(self._spool_dir))
             if n.endswith(".parquet")
         ]
+        if self._skip_windows:
+            for _ in paths[: self._skip_windows]:
+                observability.note_journal_window_skipped()
+            paths = paths[self._skip_windows :]
 
         def stage_frame(i):
             observability.note_spill_bytes_read(os.path.getsize(paths[i]))

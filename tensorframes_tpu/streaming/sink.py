@@ -33,10 +33,20 @@ from ..ops.validation import ValidationError
 
 
 class ParquetSink:
-    """Append streamed output windows to one parquet file."""
+    """Append streamed output windows to one parquet file.
+
+    Crash hygiene (round 20): the writer streams into a temp name
+    (``<path>.inprogress-<pid>``) and the file reaches ``path`` only by
+    the atomic rename inside ``close()`` — so a SIGKILL mid-footer (or
+    mid-window) never leaves a torn ``.parquet`` at the final path for
+    a resume or ``read_parquet`` to silently trust.  The pre-round-20
+    behavior wrote ``path`` directly, and a process death left an
+    unreadable footer-less file exactly where downstream readers look.
+    """
 
     def __init__(self, path, row_group_size: Optional[int] = None):
         self.path = str(path)
+        self._tmp_path = f"{self.path}.inprogress-{os.getpid()}"
         self.row_group_size = row_group_size
         self.rows = 0
         self.windows = 0
@@ -53,18 +63,22 @@ class ParquetSink:
 
         table = frame_to_table(frame)
         if self._writer is None:
-            self._writer = pq.ParquetWriter(self.path, table.schema)
+            self._writer = pq.ParquetWriter(self._tmp_path, table.schema)
         self._writer.write_table(table, row_group_size=self.row_group_size)
         self.rows += table.num_rows
         self.windows += 1
 
     def close(self) -> Dict[str, Any]:
         """Finalise the file (idempotent) and return the summary the
-        verbs hand back: path, rows, windows, on-disk bytes."""
+        verbs hand back: path, rows, windows, on-disk bytes.  The
+        footer write and the rename to the final path both happen here
+        — success, cancellation, and error paths alike get a readable
+        file ending at a window boundary."""
         if not self._closed:
             self._closed = True
             if self._writer is not None:
                 self._writer.close()
+                os.replace(self._tmp_path, self.path)
         return self.result()
 
     def result(self) -> Dict[str, Any]:
@@ -74,13 +88,107 @@ class ParquetSink:
         disk — a None path says so, instead of pointing a downstream
         reader at a file that does not exist."""
         nbytes = 0
-        if self._writer is not None and os.path.exists(self.path):
-            nbytes = os.path.getsize(self.path)
+        if self._writer is not None:
+            live = self.path if self._closed else self._tmp_path
+            if os.path.exists(live):
+                nbytes = os.path.getsize(live)
         return {
             "path": self.path if self._writer is not None else None,
             "rows": self.rows,
             "windows": self.windows,
             "bytes": nbytes,
+        }
+
+
+class DurablePartSink:
+    """Window-granular durable parquet sink: one finalized part file
+    per window under a DIRECTORY (``part-<i>.parquet``, each written to
+    a temp name and atomically renamed), so every window the journal
+    records as complete is ALSO durable on disk the instant its
+    boundary commits.
+
+    This is the sink shape durable map jobs (``job_id=``) require: a
+    single-file :class:`ParquetSink` keeps its footer in memory until
+    ``close()``, so a process death loses every written window — a
+    resume would have to re-run from row zero, breaking the
+    at-most-one-window-re-executed contract.  A directory of part files
+    is already a first-class source everywhere (``io.read_parquet``,
+    ``scan_parquet`` read sorted part dirs), and re-writing a part on
+    resume is idempotent (same window -> same bytes, atomic replace).
+
+    ``start_at`` positions a resumed sink past the journaled windows:
+    part indices stay ABSOLUTE, so the resumed directory is file-for-
+    file identical to an uninterrupted run's."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        os.makedirs(self.path, exist_ok=True)
+        self.rows = 0
+        self.windows = 0
+        self._next_index = 0
+        self._closed = False
+
+    def start_at(self, window: int, prior_rows: int) -> None:
+        self._next_index = int(window)
+        self.windows = int(window)
+        self.rows = int(prior_rows)
+
+    def discard_existing(self) -> None:
+        """Remove pre-existing part files (a FRESH job writing into a
+        reused directory): without this, a 5-window job into a dir
+        still holding an old 20-part run would overwrite parts 0-4 and
+        silently serve the stale 15 to every downstream reader —
+        ``result()`` counts whatever is on disk, by design."""
+        try:
+            for n in os.listdir(self.path):
+                if (
+                    n.startswith("part-") and n.endswith(".parquet")
+                ) or ".tmp-" in n:
+                    try:
+                        os.remove(os.path.join(self.path, n))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+
+    def write(self, frame: TensorFrame) -> None:
+        if self._closed:
+            raise ValidationError(
+                f"DurablePartSink({self.path!r}): write after close"
+            )
+        from ..io import frame_to_table
+        import pyarrow.parquet as pq
+
+        table = frame_to_table(frame)
+        part = os.path.join(
+            self.path, f"part-{self._next_index:06d}.parquet"
+        )
+        tmp = f"{part}.tmp-{os.getpid()}"
+        pq.write_table(table, tmp)
+        os.replace(tmp, part)
+        self._next_index += 1
+        self.rows += table.num_rows
+        self.windows += 1
+
+    def close(self) -> Dict[str, Any]:
+        self._closed = True
+        return self.result()
+
+    def result(self) -> Dict[str, Any]:
+        nbytes = parts = 0
+        try:
+            for n in os.listdir(self.path):
+                if n.startswith("part-") and n.endswith(".parquet"):
+                    parts += 1
+                    nbytes += os.path.getsize(os.path.join(self.path, n))
+        except OSError:
+            pass
+        return {
+            "path": self.path if parts else None,
+            "rows": self.rows,
+            "windows": self.windows,
+            "bytes": nbytes,
+            "parts": parts,
         }
 
 

@@ -139,6 +139,16 @@ os.environ.setdefault("TFS_RELEASE_HOST", "")
 # RPC's path-based sources/sinks; bridge tests allow their tmp dirs
 os.environ.setdefault("TFS_BRIDGE_PIPELINE_PATHS", "")
 
+# Durable execution (round 20, tensorframes_tpu/recovery/): the job
+# journal stays OFF in the main suite — journaling adds disk writes at
+# every window boundary and verbs only consult it when a job_id= is
+# passed, but the knob must still be pinned so a developer's exported
+# TFS_JOURNAL_DIR cannot silently make suite streams durable.  The
+# recovery tests pass tmp_path journals via monkeypatch; run_tests.sh's
+# recovery tier re-runs them with the knob live (and drives the
+# proc_kill subprocess harness).  Absence-default like every TFS_* pin.
+os.environ.setdefault("TFS_JOURNAL_DIR", "")
+
 # Static program analysis (round 17, tensorframes_tpu/analysis/): the
 # classifier itself is deterministic and its traces are suppressed from
 # the retrace counters, so it stays ON (empty = absence default = on) —
@@ -268,6 +278,12 @@ def pytest_configure(config):
         "gspmd_isolated: auto-applied to mesh tests driving manual "
         "collectives; each runs in its own interpreter (fresh XLA:CPU "
         "runtime) with native-death-only retries",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests (the subprocess-heavy recovery "
+        "kill matrix); tier-1 runs -m 'not slow', the recovery tier "
+        "runs them all",
     )
     config.addinivalue_line(
         "markers",

@@ -137,6 +137,24 @@ JAX_PLATFORMS=cpu \
   python -m pytest tests/test_recovery.py -q
 rm -rf "$TFS_REC_TMP"
 
+# Fleet tier (round 21): elastic bridge fleet — slow-marked cells
+# included: the cross-process fence race (two live processes adopt one
+# job_id; exactly one wins), the 3-replica chaos acceptance (one
+# replica SIGKILLed mid-durable-job via the replica_kill fault: zero
+# failed requests, the rerouted resume bit-identical and exactly-once
+# by counters), and the rolling restart (zero shed requests, zero
+# recompiles on rejoin via the shared persistent compile cache).  The
+# main suite runs the same file minus the slow cells; conftest pins
+# every TFS_FLEET_* knob to its absence default there — tests that
+# need a registry/fleet pass explicit roots/sizes.
+echo "== fleet tier (replication + migration + rolling restart) =="
+TFS_FLEET_TMP="$(mktemp -d)"
+TFS_FLEET_REGISTRY="$TFS_FLEET_TMP/registry" TFS_FLEET_HEALTH_S=0.2 \
+TFS_BRIDGE_CLIENT_BUSY_CAP_MS=500 \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_fleet.py -q
+rm -rf "$TFS_FLEET_TMP"
+
 # Observability tier: the flight-recorder / histogram / metrics tests
 # re-run with TFS_TRACE=1 LIVE (the main suite pins it off and tests
 # drive the recorder via observability.enable_trace(); this tier proves

@@ -31,6 +31,15 @@ request coalescing into bucket-canonical micro-batches over a warm
 program pool (``Coalescer`` / ``WarmPool``), SLO-aware fair-share
 admission (``SloScheduler``), and continuous decode batching
 (``ContinuousBatcher``).
+
+Round 21 scales the seam OUT: ``fleet`` runs N replicas behind a
+rendezvous-hashing ``FleetRouter`` (health-polled, flap-quarantining),
+``BridgeClient`` grows router-driven failover (``Draining`` /
+connection death / ``SessionLost`` reroute to a healthy peer; durable
+jobs migrate via the round-20 journal), and ``BridgeFleet`` provides
+the kill/drain/restart/rolling-restart levers plus the shared
+compile-cache topology that makes a rejoining replica warm
+(``docs/SERVING.md`` fleet section, ``docs/RESILIENCE.md``).
 """
 
 from .client import (
@@ -41,7 +50,10 @@ from .client import (
     Draining,
     RemoteFrame,
     ServerBusy,
+    SessionLost,
+    busy_backoff_s,
 )
+from .fleet import BridgeFleet, FleetClient, FleetRouter
 from .coalescer import (
     Coalescer,
     ContinuousBatcher,
@@ -54,16 +66,21 @@ from .server import BridgeServer, serve
 __all__ = [
     "BridgeClient",
     "BridgeError",
+    "BridgeFleet",
     "BridgeServer",
     "Cancelled",
     "Coalescer",
     "ContinuousBatcher",
     "DeadlineExceeded",
     "Draining",
+    "FleetClient",
+    "FleetRouter",
     "RemoteFrame",
     "ServerBusy",
+    "SessionLost",
     "SloScheduler",
     "WarmPool",
     "WarmSpec",
+    "busy_backoff_s",
     "serve",
 ]

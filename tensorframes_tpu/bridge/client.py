@@ -36,12 +36,23 @@ Client-side resilience (round 11):
   re-sends — never past the call's deadline, and never for ``Draining``
   (a draining server wants you gone, not back).  At 0 the pre-round-16
   behavior stands: sheds surface immediately and routing is the
-  caller's policy.
+  caller's policy.  Round 21 caps the hint
+  (``TFS_BRIDGE_CLIENT_BUSY_CAP_MS``) and decorrelates it with jitter
+  (:func:`busy_backoff_s`) so a fleet's shed clients never re-arrive in
+  lockstep.
+* **Fleet failover** (round 21): with ``router=`` wired in (a
+  :class:`~tensorframes_tpu.bridge.fleet.FleetRouter`), connection
+  failures, ``Draining``, and ``SessionLost`` re-route the call to a
+  healthy peer instead of surfacing — a fresh session there (frames do
+  not follow; re-upload), with durable jobs migrating via the journal
+  when their re-sent request carries its ``job_id``.  Budget: one
+  reroute per known peer per call.
 """
 
 from __future__ import annotations
 
 import logging
+import random
 import socket
 import threading
 import time
@@ -51,7 +62,7 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence
 import numpy as np
 
 from .. import observability, resilience
-from ..envutil import env_int, env_opt_float
+from ..envutil import env_float, env_int, env_opt_float
 from .protocol import decode_value, encode_value, read_message, write_message
 
 logger = logging.getLogger("tensorframes_tpu.bridge.client")
@@ -59,9 +70,11 @@ logger = logging.getLogger("tensorframes_tpu.bridge.client")
 ENV_CLIENT_TIMEOUT_S = "TFS_BRIDGE_CLIENT_TIMEOUT_S"
 ENV_CLIENT_RETRIES = "TFS_BRIDGE_CLIENT_RETRIES"
 ENV_CLIENT_BUSY_RETRIES = "TFS_BRIDGE_CLIENT_BUSY_RETRIES"
+ENV_CLIENT_BUSY_CAP_MS = "TFS_BRIDGE_CLIENT_BUSY_CAP_MS"
 
 DEFAULT_RECONNECT_RETRIES = 3
 DEFAULT_BACKOFF_S = 0.05
+DEFAULT_BUSY_CAP_MS = 1000.0
 
 # when a call has a deadline but the client has NO configured socket
 # timeout, the reply read is still bounded at deadline + a grace (the
@@ -80,6 +93,30 @@ def _read_grace_s(remaining_s: float) -> float:
         DEADLINE_READ_GRACE_MAX_S,
         max(DEADLINE_READ_GRACE_MIN_S, 2.0 * remaining_s),
     )
+
+
+def busy_backoff_s(
+    hint_ms: float,
+    cap_ms: float = DEFAULT_BUSY_CAP_MS,
+    attempt: int = 0,
+    rng=None,
+) -> float:
+    """The busy-retry sleep, in seconds (round 21).
+
+    The server's ``retry_after_ms`` hint is deterministic per shed — so
+    a fleet's worth of clients shed in the same overload wave would all
+    re-arrive in lockstep, a thundering herd the admission gate sheds
+    again, forever.  Cap the hint at ``cap_ms`` (a server under duress
+    can hint arbitrarily far; the CLIENT owns how long it is willing to
+    stall), grow it per ``attempt`` (2x, still capped), and draw
+    uniformly from [half, full] of that target — decorrelated enough
+    that re-arrivals spread across half a window, while every draw
+    still respects at least half the server's hint."""
+    capped = min(max(float(hint_ms), 1.0), float(cap_ms))
+    target = min(capped * (2.0 ** max(0, int(attempt))), float(cap_ms))
+    lo = target / 2.0
+    draw = rng.random() if rng is not None else random.random()
+    return (lo + draw * (target - lo)) / 1e3
 
 # methods whose re-execution is harmless AND cheap: control-plane reads
 # plus ``release`` (a pop that ignores unknown ids — naturally
@@ -203,9 +240,20 @@ class BridgeClient:
         rng=None,
         tenant: Optional[str] = None,
         busy_retries: Optional[int] = None,
+        router=None,
     ):
         self._host = host
         self._port = int(port)
+        # round 21 — fleet failover: with a router wired in, connection
+        # failures, ``Draining``, and ``SessionLost`` re-route this
+        # client to a healthy peer (fresh session there; durable jobs
+        # migrate via the journal when their request is re-sent with
+        # its job_id).  ``failovers`` counts reroutes on this client;
+        # ``server_replica`` is the identity dict the last successful
+        # hello returned (None on pre-round-21 servers).
+        self.router = router
+        self.failovers = 0
+        self.server_replica: Optional[Dict[str, Any]] = None
         # request-scoped telemetry (round 15): every GATED call is
         # stamped with a fresh correlation id (STABLE across that
         # call's reconnect retries, so a retried request attributes to
@@ -230,6 +278,9 @@ class BridgeClient:
         if busy_retries is None:
             busy_retries = env_int(ENV_CLIENT_BUSY_RETRIES, 0)
         self._busy_retries = int(busy_retries)
+        self._busy_cap_ms = env_float(
+            ENV_CLIENT_BUSY_CAP_MS, DEFAULT_BUSY_CAP_MS
+        )
         self._backoff_s = float(backoff_s)
         self._jitter = float(jitter)
         self._rng = rng
@@ -331,7 +382,42 @@ class BridgeClient:
             self._teardown_locked()
             _raise_remote(err)
         self.session_token = resp["result"]["session"]
+        self.server_replica = resp["result"].get("replica")
         sock.settimeout(self._timeout_s)
+
+    def _failover_locked(self, reason: str, failed: bool) -> bool:
+        """Re-point this client at a healthy peer (round 21): tell the
+        router what happened to the current address (``failed`` = dead
+        connection, else draining/restarted-but-alive), pick the
+        rendezvous choice among the OTHER replicas, and drop the
+        session token — the reattach is a fresh session on the new
+        replica (frames do not follow; durable jobs do, via the
+        journal, when call() re-sends their request).  False when no
+        router or no other replica is known."""
+        if self.router is None:
+            return False
+        addr = (self._host, self._port)
+        try:
+            if failed:
+                self.router.note_failed(addr)
+            else:
+                self.router.note_draining(addr)
+            nxt = self.router.pick(exclude=addr)
+        except Exception:  # noqa: BLE001 — a sick router must not mask
+            logger.warning("bridge: fleet router errored", exc_info=True)
+            return False
+        if nxt is None or tuple(nxt) == addr:
+            return False
+        self._teardown_locked()
+        self._host, self._port = nxt
+        self.session_token = None
+        self.failovers += 1
+        observability.note_fleet_failover()
+        logger.warning(
+            "bridge: failing over to %s:%d (%s at %s:%d)",
+            nxt[0], nxt[1], reason, addr[0], addr[1],
+        )
+        return True
 
     def _roundtrip_locked(self, msg: dict, bins: Optional[list] = None):
         write_message(self._wfile, msg, bins)
@@ -379,6 +465,12 @@ class BridgeClient:
         # can never find (e.g. the attribution lookup itself)
         cid = None if safe else observability.new_correlation_id()
         busy_left = 0 if safe else self._busy_retries
+        busy_attempt = 0
+        # one reroute per known peer: a call may walk the fleet once,
+        # but a fully-dead fleet still surfaces promptly
+        failover_left = (
+            self.router.failover_budget() if self.router is not None else 0
+        )
         with self._lock:
             if cid is not None:
                 self.last_correlation_id = cid
@@ -477,6 +569,16 @@ class BridgeClient:
                         # a misleading unknown-frame-id — surface the
                         # real connection failure instead
                         raise
+                    if failover_left > 0 and self._failover_locked(
+                        f"{type(exc).__name__}: {exc}", failed=True
+                    ):
+                        # round 21: a dead connection with a router
+                        # configured reroutes NOW instead of burning the
+                        # reconnect budget on a corpse; the new replica
+                        # gets a fresh detector budget of its own
+                        failover_left -= 1
+                        detector = None
+                        continue
                     if detector is None:
                         detector = resilience.FailureDetector(
                             max_restarts=self._retries,
@@ -511,23 +613,58 @@ class BridgeClient:
                     )
                     time.sleep(delay)
                     continue
+                except SessionLost:
+                    # the reattach found a restarted (or TTL-reaped)
+                    # server: frames are gone either way.  With a
+                    # router, reroute the reattach to a peer (round 21)
+                    # — the re-sent request runs on a fresh session
+                    # there, and a durable ``job_id`` adopts its journal
+                    # fence and resumes.  Without one, round-20
+                    # semantics stand: surface it.
+                    if failover_left > 0 and self._failover_locked(
+                        "session lost", failed=False
+                    ):
+                        failover_left -= 1
+                        continue
+                    raise
                 rbins = resp.pop("_bins")
                 if "error" in resp:
                     err = resp["error"]
+                    if (
+                        err.get("code") == "draining"
+                        and failover_left > 0
+                        and self._failover_locked(
+                            "server draining", failed=False
+                        )
+                    ):
+                        # round 21: Draining is a failover signal when a
+                        # router is configured — the drained request was
+                        # never executed or cached, so re-sending the
+                        # SAME idem token + cid on a peer is still one
+                        # logical call
+                        failover_left -= 1
+                        continue
                     if (
                         err.get("code") == "server_busy"
                         and busy_left > 0
                     ):
                         # honor the server's retry_after_ms hint (round
-                        # 16): the shed was never executed or cached, so
-                        # re-sending the SAME idem token + cid keeps the
-                        # retry a continuation of this logical call.
-                        # Never sleep past the deadline — surfacing the
-                        # shed beats converting it into a silent
+                        # 16) — capped and decorrelated (round 21: raw
+                        # deterministic hints synchronize a fleet's shed
+                        # clients into thundering herds): the shed was
+                        # never executed or cached, so re-sending the
+                        # SAME idem token + cid keeps the retry a
+                        # continuation of this logical call.  Never
+                        # sleep past the deadline — surfacing the shed
+                        # beats converting it into a silent
                         # deadline_exceeded.
-                        delay = (
-                            float(err.get("retry_after_ms", 50)) / 1e3
+                        delay = busy_backoff_s(
+                            float(err.get("retry_after_ms", 50)),
+                            cap_ms=self._busy_cap_ms,
+                            attempt=busy_attempt,
+                            rng=self._rng,
                         )
+                        busy_attempt += 1
                         if deadline_end is not None and (
                             time.monotonic() + delay >= deadline_end
                         ):

@@ -988,12 +988,19 @@ class SloScheduler:
         return None
 
     def snapshot(self) -> Dict[str, Any]:
+        p99_s = self._bridge_p99_s()
         return {
             "enabled": self.enabled(),
             "fair_rows": self.fair_rows,
             "window_s": self.window_s,
             "slo_ms": self.slo_ms,
             "rows_by_tenant": self._rows_by_tenant(),
+            # round 21: the worst gated-method p99 (None until 8+
+            # samples) — surfaced through ``health`` so the fleet
+            # router's latency-SLO signal needs no metrics scrape
+            "p99_ms": (
+                round(p99_s * 1000.0, 3) if p99_s is not None else None
+            ),
         }
 
 

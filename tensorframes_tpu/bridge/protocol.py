@@ -18,6 +18,13 @@ METHOD, not a wire change: ``metrics`` (ungated, like ``health``)
 returns ``{"text": <Prometheus exposition>}`` — an old server answers
 it with the standard unknown-method error, so the version stays 2 here
 too.
+Round 21 is additive the same way: ``hello`` replies and the ``health``
+payload gain a ``"replica"`` identity object (``{"name", "pid",
+"epoch", "uptime_s"}`` — the epoch token is new per server START, which
+is how a fleet router tells a restarted replica from a recovered one),
+and ``health``'s ``scheduler`` object gains ``"p99_ms"``.  Old clients
+ignore the extra keys; old servers simply omit them (clients treat a
+missing ``"replica"`` as a pre-fleet server) — the version stays 2.
 Small tensors ride inline as ``{"__tensor__": {"dtype", "shape",
 "data"(b64)}}``; binary cells as ``{"__bytes__": b64}``.
 

@@ -75,6 +75,7 @@ from ..ops import engine as _engine_mod
 from ..ops.engine import GroupedFrame
 from ..ops.validation import ValidationError
 from . import coalescer as _coalescer
+from . import fleet as _fleet
 from .protocol import (
     PROTOCOL_VERSION,
     decode_value,
@@ -958,7 +959,14 @@ class _Handler(socketserver.StreamRequestHandler):
                 server._detach(self._session)
             self._session = sess
             return {
-                "result": {"session": sess.token, "pv": PROTOCOL_VERSION}
+                "result": {
+                    "session": sess.token,
+                    "pv": PROTOCOL_VERSION,
+                    # round 21 (additive): which replica answered, so a
+                    # failover client can tell whether its reattach
+                    # landed somewhere new
+                    "replica": server.replica_identity(),
+                }
             }, []
         if method == "health":
             bins: list = []
@@ -1004,6 +1012,11 @@ class _Handler(socketserver.StreamRequestHandler):
             if faults.bridge_active()
             else None
         )
+        if fplan is not None and fplan.kill_after_ms is not None:
+            # round 21 chaos: arm a real SIGKILL on a daemon timer and
+            # keep executing — the process dies MID-request, exactly the
+            # death the fleet failover + journal migration must survive
+            faults.schedule_replica_kill(fplan.kill_after_ms)
         gated = method in _GATED_METHODS
         if not gated:
             if method not in _UNGATED_METHODS:
@@ -1325,6 +1338,14 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         self.scheduler = _coalescer.SloScheduler(
             fair_rows=fair_rows, window_s=fair_window_s, slo_ms=slo_ms
         )
+        # round 21 — stable replica identity: pid + a start-time epoch
+        # token.  The NAME is stable across restarts (the fleet spawner
+        # pins it via TFS_FLEET_REPLICA); the EPOCH changes every start,
+        # which is how a router tells "same replica recovered" from
+        # "replica restarted" without guessing from connection resets.
+        self._started_mono = time.monotonic()
+        self._replica_name = _env_raw(_fleet.ENV_FLEET_REPLICA, "")
+        self._replica_epoch = f"{os.getpid():x}-{uuid.uuid4().hex[:12]}"
         self._sessions: Dict[str, _Session] = {}
         self._sessions_lock = threading.Lock()
         # per-request attribution history (round 15): ledger snapshots
@@ -1346,6 +1367,19 @@ class BridgeServer(socketserver.ThreadingTCPServer):
                 target=self._reap_loop, name="tfs-bridge-reaper", daemon=True
             )
             t.start()
+        # round 21 — fleet registry heartbeat: one atomic JSON file per
+        # replica whose mtime is the liveness signal the janitor and
+        # peers trust ACROSS processes (a same-host ``os.kill(pid, 0)``
+        # cannot see into another container or pid namespace).  No
+        # registry configured (TFS_FLEET_REGISTRY unset) = no-op.
+        self._registry_dir = _fleet.registry_dir()
+        if self._registry_dir:
+            self._registry_beat()
+            threading.Thread(
+                target=self._registry_loop,
+                name="tfs-fleet-heartbeat",
+                daemon=True,
+            ).start()
         # metrics exposition (round 13): the admission gauges register as
         # providers so the standalone TFS_METRICS_PORT endpoint (started
         # here from the env when set) scrapes them alongside the process
@@ -1401,6 +1435,30 @@ class BridgeServer(socketserver.ThreadingTCPServer):
             logger.warning(
                 "bridge: journal startup recovery failed", exc_info=True
             )
+
+    def _registry_name(self) -> str:
+        return self._replica_name or f"pid{os.getpid()}"
+
+    def _registry_beat(self) -> None:
+        try:
+            _fleet.registry_write(
+                self._registry_name(),
+                self.address[0],
+                self.address[1],
+                epoch=self._replica_epoch,
+                root=self._registry_dir,
+            )
+        except OSError:
+            logger.warning(
+                "bridge: fleet-registry heartbeat failed", exc_info=True
+            )
+
+    def _registry_loop(self) -> None:
+        # 3 beats per TTL: one missed write (busy box, slow fs) never
+        # reads as death
+        interval = max(0.5, _fleet.REGISTRY_TTL_S / 3.0)
+        while not self._reaper_stop.wait(interval):
+            self._registry_beat()
 
     def _admission_gauges(self) -> Dict[str, Any]:
         s = self.gate.snapshot()
@@ -1629,6 +1687,19 @@ class BridgeServer(socketserver.ThreadingTCPServer):
 
     # -- health --------------------------------------------------------------
 
+    def replica_identity(self) -> Dict[str, Any]:
+        """Stable replica identity (round 21): fleet-assigned name
+        (stable across restarts; '' outside a fleet), pid, start-time
+        EPOCH token (new every start — a router seeing a new epoch
+        under an old name knows the replica RESTARTED rather than
+        recovered, without guessing from connection resets), uptime."""
+        return {
+            "name": self._replica_name,
+            "pid": os.getpid(),
+            "epoch": self._replica_epoch,
+            "uptime_s": round(time.monotonic() - self._started_mono, 3),
+        }
+
     def health_snapshot(self) -> Dict[str, Any]:
         """The ``health`` RPC body: admission depth, drain state,
         session/frame counts, device-quarantine history (PR 4), and HBM
@@ -1644,6 +1715,9 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         return {
             "status": "draining" if gate["draining"] else "ok",
             **gate,
+            # round 21: who answered — the fleet router keys flap/restart
+            # detection off the epoch token in here
+            "replica": self.replica_identity(),
             "sessions": n_sessions,
             "frames": n_frames,
             "quarantined_devices": device_pool.recently_quarantined(),
@@ -1675,6 +1749,21 @@ class BridgeServer(socketserver.ThreadingTCPServer):
                     "warm_program_hits",
                     "fair_share_sheds",
                     "slo_sheds",
+                    # round 21: the fleet acceptance evidence — journal
+                    # exactly-once accounting, persistent-compile-cache
+                    # hits (zero-recompile proof on warm rejoin), and
+                    # the fleet lifecycle counters
+                    "stream_windows",
+                    "journal_appends",
+                    "journal_windows_skipped",
+                    "journal_resumes",
+                    "journal_fence_rejections",
+                    "persistent_cache_hits",
+                    "persistent_cache_misses",
+                    "fleet_failovers",
+                    "fleet_jobs_migrated",
+                    "fleet_quarantines",
+                    "fleet_replica_restarts",
                 )
             },
             # round 13: the gauge snapshot serving operators need
@@ -1758,6 +1847,12 @@ class BridgeServer(socketserver.ThreadingTCPServer):
             return
         self._closed = True
         self._reaper_stop.set()
+        if self._registry_dir:
+            # leave no heartbeat behind: a cleanly-closed replica's pid
+            # must not pin journal artifacts against the janitor
+            _fleet.registry_remove(
+                self._registry_name(), root=self._registry_dir
+            )
         for name, fn in self._gauge_providers.items():
             observability.unregister_gauge(name, fn)
         budget = self.drain_s if drain_s is None else float(drain_s)

@@ -557,6 +557,89 @@ def _rule_indep_probe_churn(c) -> Optional[Dict[str, Any]]:
     )
 
 
+FLEET_IMBALANCE_RATIO = 4.0  # busiest replica's sessions vs fleet mean
+
+
+def _rule_replica_flap(fleet) -> Optional[Dict[str, Any]]:
+    """A fleet replica is flapping (round 21): down transitions and/or
+    silent restarts (epoch changes) inside the router's flap window at
+    or past the quarantine threshold, or an active quarantine.  Each
+    flap dumps that replica's sessions onto its peers and re-pays warm
+    state; a flapper that keeps rejoining is worse than one that stays
+    down."""
+    if not fleet:
+        return None
+    reps = fleet.get("replicas") or {}
+    threshold = max(1, int(fleet.get("quarantine_after") or 1))
+    worst = None
+    for name, r in reps.items():
+        flaps = int(r.get("flaps_recent") or 0)
+        if r.get("quarantined") or flaps >= threshold:
+            if worst is None or flaps > worst[1]:
+                worst = (name, flaps, r)
+    if worst is None:
+        return None
+    name, flaps, r = worst
+    state = "quarantined" if r.get("quarantined") else "flapping"
+    return _diag(
+        "replica_flap",
+        "warn",
+        f"fleet replica {name} is {state}: {flaps} flap(s) in the last "
+        f"{fleet.get('flap_window_s')}s (threshold "
+        f"{threshold}) — its sessions keep spilling onto peers",
+        {"replica": name, **{k: r.get(k) for k in (
+            "flaps_recent", "quarantined", "healthy", "draining",
+            "epoch", "uptime_s")}},
+        "TFS_FLEET_QUARANTINE_AFTER",
+        "find why the replica keeps dying/restarting (its log, OOM "
+        "kills, TFS_FAULT_INJECT leftovers); quarantine holds it out "
+        "for TFS_FLEET_QUARANTINE_S so the fleet stabilizes — lower "
+        "TFS_FLEET_QUARANTINE_AFTER to quarantine sooner, and prefer "
+        "a drained rolling restart (BridgeFleet.rolling_restart) over "
+        "letting it crash-loop",
+    )
+
+
+def _rule_fleet_imbalance(fleet) -> Optional[Dict[str, Any]]:
+    """One replica carries far more sessions than the fleet mean (round
+    21).  Rendezvous hashing balances KEYS, not load — a hot key (one
+    client funneling everything through one session token) or a
+    shrunken eligible set (peers draining/quarantined) concentrates
+    work on one replica, which then sheds while its peers idle."""
+    if not fleet:
+        return None
+    reps = fleet.get("replicas") or {}
+    if len(reps) < 2:
+        return None
+    sessions = {n: int(r.get("sessions") or 0) for n, r in reps.items()}
+    total = sum(sessions.values())
+    if total < MIN_EVENTS:
+        return None
+    mean = total / len(sessions)
+    top_name, top = max(sessions.items(), key=lambda kv: kv[1])
+    if top < FLEET_IMBALANCE_RATIO * max(mean, 1.0):
+        return None
+    ineligible = [
+        n for n, r in reps.items()
+        if r.get("draining") or r.get("quarantined") or not r.get("healthy")
+    ]
+    return _diag(
+        "fleet_imbalance",
+        "warn",
+        f"fleet replica {top_name} holds {top} of {total} sessions "
+        f"(mean {mean:.1f} across {len(sessions)} replicas) — the "
+        f"fleet is keyed onto one replica",
+        {"sessions": sessions, "mean": round(mean, 2),
+         "ineligible": ineligible},
+        "TFS_FLEET_SIZE",
+        "spread clients across distinct routing keys (one FleetClient "
+        "key per logical session, not one shared key); return drained/"
+        "quarantined peers to eligibility so rendezvous has somewhere "
+        "to spread (check the ineligible list), or raise TFS_FLEET_SIZE "
+        "if every replica is genuinely saturated",
+    )
+
+
 def doctor(
     counters: Optional[Mapping[str, Any]] = None,
     latency: Optional[Mapping[str, Mapping[str, Any]]] = None,
@@ -566,6 +649,7 @@ def doctor(
     shuffles: Optional[Sequence[Mapping[str, Any]]] = None,
     plans: Optional[Sequence[Mapping[str, Any]]] = None,
     artifacts: Optional[Mapping[str, Any]] = None,
+    fleet: Optional[Mapping[str, Any]] = None,
 ) -> List[Dict[str, Any]]:
     """Diagnose the process's (or the given snapshots') performance
     state.  Returns structured diagnostics, worst first — each names
@@ -610,6 +694,13 @@ def doctor(
             artifacts = janitor.summary()
         except Exception:  # noqa: BLE001 — diagnosis must never fail here
             artifacts = {}
+    if fleet is None:
+        try:  # round 21: the live fleet router's view, when one exists
+            from .bridge import fleet as _fleet_mod
+
+            fleet = _fleet_mod.doctor_snapshot() or {}
+        except Exception:  # noqa: BLE001 — diagnosis must never fail here
+            fleet = {}
     out: List[Dict[str, Any]] = []
     for rule in (
         lambda: _rule_shed_burn(c),
@@ -623,6 +714,8 @@ def doctor(
         lambda: _rule_shuffle_skew(shuffles),
         lambda: _rule_cse_miss(c, plans),
         lambda: _rule_stale_artifacts(artifacts),
+        lambda: _rule_replica_flap(fleet),
+        lambda: _rule_fleet_imbalance(fleet),
         lambda: _rule_indep_probe_churn(c),
         lambda: _rule_slow_tail(lat),
     ):

@@ -20,6 +20,16 @@ def env_raw(name: str, default: str = "") -> str:
     return os.environ.get(name, default).strip()
 
 
+def env_set_default(name: str, value: str) -> None:
+    """Pin env knob ``name`` to ``value`` for THIS process unless the
+    environment already set it.  The one sanctioned ``TFS_*`` env
+    WRITE: entrypoints that translate argv into knobs the library
+    layer reads at startup (``bridge.replica --name`` pinning the
+    replica identity before ``serve()``) go through here, keeping the
+    env-routing lint's no-raw-access guarantee intact."""
+    os.environ.setdefault(name, value)
+
+
 def env_int(name: str, default: int, floor: int = 0) -> int:
     """``int(os.environ[name])`` clamped to ``floor``; ``default`` when
     unset or malformed."""

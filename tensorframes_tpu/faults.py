@@ -36,6 +36,18 @@ case the idempotent client retry exists for)::
 
     TFS_FAULT_INJECT="bridge_drop:method=map_blocks:call=0"
 
+Fleet kind (round 21, consumed by ``bridge/server.py`` like the other
+bridge kinds): ``replica_kill:ms=`` SIGKILLs the SERVER process ``ms``
+milliseconds after the matched request starts dispatching — the
+replica-death lever the fleet chaos harness (``bridge/fleet.py``,
+``tests/test_fleet.py``) drives: the request is mid-execution when the
+process dies, so the client sees a severed connection, reroutes to a
+healthy replica, and the durable job resumes from its last journal
+boundary.  ``ms=0`` (the default) kills before execution begins.
+Selectors are the bridge ones (``method=``/``call=``/``rate``/``seed``)::
+
+    TFS_FAULT_INJECT="replica_kill:method=pipeline:call=0:ms=400"
+
 Bridge injection targets SESSION-BOUND RPC methods (the gated verbs plus
 ping/schema/release); the connection control plane — ``hello``,
 ``health``, ``end_session`` — dispatches before the injection hook and
@@ -105,7 +117,14 @@ ENV_VAR = "TFS_FAULT_INJECT"
 # exists for).  Selectors ``method=NAME`` and ``call=N`` (the N-th
 # invocation of that method in the session, 0-based) target them.
 _ENGINE_KINDS = ("transient", "oom", "delay")
-_BRIDGE_KINDS = ("bridge_stall", "bridge_delay", "bridge_drop")
+# ``replica_kill`` (round 21) is bridge-SCOPED (method=/call= selectors,
+# fired from the server's per-request injection hook) but its action is
+# the boundary kind's: SIGKILL this process.  The distinction from
+# ``proc_kill``: it targets a REQUEST (the replica dies mid-job while
+# serving it), not a journal boundary index — the death the fleet's
+# journal-backed migration exists to survive.
+_BRIDGE_KINDS = ("bridge_stall", "bridge_delay", "bridge_drop",
+                 "replica_kill")
 # boundary kinds (round 20) fire at the durable-job journal's
 # window/epoch boundary choke point (``recovery/journal.py``
 # ``JournalWriter.append``): ``proc_kill`` SIGKILLs THIS process — the
@@ -390,18 +409,26 @@ def maybe_inject(
 class BridgeFaultPlan:
     """The aggregated bridge-injection actions for one request:
     ``stall_ms`` (sleep before execution, inside the request's cancel
-    scope), ``delay_ms`` (sleep after execution, before the reply), and
-    ``drop`` (sever the connection instead of replying)."""
+    scope), ``delay_ms`` (sleep after execution, before the reply),
+    ``drop`` (sever the connection instead of replying), and
+    ``kill_after_ms`` (round 21: SIGKILL the server process that many
+    milliseconds after dispatch begins — ``None`` = no kill)."""
 
-    __slots__ = ("stall_ms", "delay_ms", "drop")
+    __slots__ = ("stall_ms", "delay_ms", "drop", "kill_after_ms")
 
     def __init__(self):
         self.stall_ms = 0.0
         self.delay_ms = 0.0
         self.drop = False
+        self.kill_after_ms: Optional[float] = None
 
     def __bool__(self) -> bool:
-        return bool(self.stall_ms or self.delay_ms or self.drop)
+        return bool(
+            self.stall_ms
+            or self.delay_ms
+            or self.drop
+            or self.kill_after_ms is not None
+        )
 
 
 def maybe_inject_bridge(method: str, call: int) -> Optional[BridgeFaultPlan]:
@@ -428,9 +455,36 @@ def maybe_inject_bridge(method: str, call: int) -> Optional[BridgeFaultPlan]:
             out.stall_ms += spec.ms
         elif spec.kind == "bridge_delay":
             out.delay_ms += spec.ms
+        elif spec.kind == "replica_kill":
+            out.kill_after_ms = spec.ms
         else:
             out.drop = True
     return out if out else None
+
+
+def schedule_replica_kill(after_ms: float) -> None:
+    """Arm a ``replica_kill``: SIGKILL this process ``after_ms``
+    milliseconds from now, from a daemon timer so the matched request
+    keeps executing and dies MID-flight — no cleanup, no flushed
+    buffers, the same death :func:`maybe_kill_boundary` deals (and the
+    same one a real replica eviction deals).  ``after_ms<=0`` kills
+    synchronously, before the request executes at all."""
+    import signal
+    import threading
+
+    def _die():
+        logger.warning(
+            "faults: replica_kill firing (%.0fms after dispatch)",
+            after_ms,
+        )
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    if after_ms <= 0:
+        _die()
+        return
+    t = threading.Timer(after_ms / 1000.0, _die)
+    t.daemon = True
+    t.start()
 
 
 _OOM_MARKERS = ("resource_exhausted", "resource exhausted", "out of memory")

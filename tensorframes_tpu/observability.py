@@ -192,6 +192,16 @@ _counters: Dict[str, int] = {
     "journal_windows_skipped": 0,
     "journal_resumes": 0,
     "journal_fence_rejections": 0,
+    # elastic bridge fleet (round 21, bridge/fleet.py): client calls
+    # rerouted to a healthy replica (draining or dead origin), durable
+    # jobs that RESUMED on a different replica than the one that started
+    # them (the journal-backed migration evidence), replicas the router
+    # quarantined for flapping, and replica restarts the fleet performed
+    # (rolling restarts included)
+    "fleet_failovers": 0,
+    "fleet_jobs_migrated": 0,
+    "fleet_quarantines": 0,
+    "fleet_replica_restarts": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
 
@@ -858,6 +868,33 @@ def note_journal_fence_rejection() -> None:
     _bump("journal_fence_rejections")
 
 
+def note_fleet_failover() -> None:
+    """One client call rerouted to a different replica (the origin was
+    draining, dead, or had forgotten the session) by the router-aware
+    retry loop (``bridge/client.py`` + ``bridge/fleet.py``)."""
+    _bump("fleet_failovers")
+
+
+def note_fleet_job_migrated() -> None:
+    """One durable job that RESUMED on a different replica than the one
+    that started it — the failed-over re-issue adopted the journal fence
+    and continued from the last window boundary."""
+    _bump("fleet_jobs_migrated")
+
+
+def note_fleet_quarantine() -> None:
+    """One replica the fleet router quarantined for flapping (repeated
+    up/down transitions inside the flap window) — the replica analog of
+    ``devices_quarantined``."""
+    _bump("fleet_quarantines")
+
+
+def note_fleet_replica_restart() -> None:
+    """One replica process the fleet restarted (rolling restarts and
+    crash replacements alike)."""
+    _bump("fleet_replica_restarts")
+
+
 def note_stream_window() -> None:
     """One streamed window materialised into host columns by the
     windowed reader (``streaming/reader.py``)."""
@@ -1022,6 +1059,10 @@ def counters_delta(
             "journal_windows_skipped",
             "journal_resumes",
             "journal_fence_rejections",
+            "fleet_failovers",
+            "fleet_jobs_migrated",
+            "fleet_quarantines",
+            "fleet_replica_restarts",
         )
     }
 

@@ -10,8 +10,11 @@ garbage accumulated in ``TFS_SPILL_DIR`` forever.  The janitor closes
 the leak:
 
 * :func:`scan` inventories stale artifacts (dead-pid liveness via
-  ``os.kill(pid, 0)``; journal job dirs additionally consult the fence
-  owner) without touching anything;
+  ``os.kill(pid, 0)`` AND, round 21, the fleet registry's heartbeat
+  files — an artifact owned by a pid alive anywhere in the fleet is
+  never reclaimable, because a same-host signal probe cannot see into
+  another container's pid namespace; journal job dirs additionally
+  consult the fence owner) without touching anything;
 * :func:`reclaim` deletes what :func:`scan` marked reclaimable and
   returns (count, bytes);
 * the ``stale_artifacts`` doctor rule (``tfs.doctor()``) surfaces the
@@ -63,6 +66,31 @@ def pid_alive(pid: int) -> bool:
     return True
 
 
+def _fleet_live_pids() -> frozenset:
+    """Pids with a fresh heartbeat in the fleet registry (round 21), or
+    the empty set when no registry is configured.  ``os.kill(pid, 0)``
+    only sees THIS process's pid namespace — a fleet replica in another
+    container can look dead from here while very much alive and mid-job,
+    and reclaiming its journal states would corrupt its resume.  The
+    registry heartbeat is the cross-process source of truth."""
+    try:
+        from ..bridge import fleet as _fleet
+
+        return _fleet.registry_live_pids()
+    except Exception:  # noqa: BLE001 — a sick registry must not stop the scan
+        logger.warning(
+            "janitor: fleet-registry liveness unavailable", exc_info=True
+        )
+        return frozenset()
+
+
+def _dead(pid, fleet_live: frozenset) -> bool:
+    """The janitor's reclaim predicate: dead to this process's view AND
+    not alive anywhere in the fleet registry."""
+    pid = int(pid)
+    return not pid_alive(pid) and pid not in fleet_live
+
+
 def _size_of(path: str) -> int:
     try:
         if os.path.isdir(path):
@@ -89,7 +117,9 @@ def _artifact(path: str, kind: str, pid, reclaimable: bool) -> Dict[str, Any]:
     }
 
 
-def _scan_spill_root(root: str) -> List[Dict[str, Any]]:
+def _scan_spill_root(
+    root: str, fleet_live: frozenset = frozenset()
+) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     try:
         names = os.listdir(root)
@@ -99,7 +129,7 @@ def _scan_spill_root(root: str) -> List[Dict[str, Any]]:
         path = os.path.join(root, n)
         m = _TMP_PAT.search(n)
         if m is not None:
-            if not pid_alive(int(m.group(1))):
+            if _dead(m.group(1), fleet_live):
                 out.append(_artifact(path, "tmp", m.group(1), True))
             continue
         for kind, pat in _SPILL_PATTERNS:
@@ -107,13 +137,15 @@ def _scan_spill_root(root: str) -> List[Dict[str, Any]]:
             if m is None:
                 continue
             pid = int(m.group(1))
-            if not pid_alive(pid):
+            if _dead(pid, fleet_live):
                 out.append(_artifact(path, kind, pid, True))
             break
     return out
 
 
-def _scan_journal_root(root: str) -> List[Dict[str, Any]]:
+def _scan_journal_root(
+    root: str, fleet_live: frozenset = frozenset()
+) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     jj = _journal.JobJournal(root)
     for job_id in jj.list_jobs():
@@ -121,7 +153,7 @@ def _scan_journal_root(root: str) -> List[Dict[str, Any]]:
         doc, _tok = jj._current_manifest(jdir)
         fence = jj._read_fence(jdir)
         owner = (fence or {}).get("pid")
-        owner_dead = owner is not None and not pid_alive(owner)
+        owner_dead = owner is not None and _dead(owner, fleet_live)
         referenced = set()
         keep_manifests = set()
         if doc is not None:
@@ -149,7 +181,7 @@ def _scan_journal_root(root: str) -> List[Dict[str, Any]]:
             if _TMP_PAT.search(n):
                 # atomic-write temps embed their writer's pid
                 m = _TMP_PAT.search(n)
-                if not pid_alive(int(m.group(1))):
+                if _dead(m.group(1), fleet_live):
                     out.append(_artifact(path, "tmp", m.group(1), True))
             elif n.startswith(("state-", "result-", "shufrun-")) and (
                 n.endswith(".npz")
@@ -182,10 +214,13 @@ def scan(
     out: List[Dict[str, Any]] = []
     sroot = _spill.spill_dir() if spill_root is None else spill_root
     jroot = _journal.journal_dir() if journal_root is None else journal_root
+    # one registry read per sweep (round 21): every reclaim decision in
+    # this scan sees the same fleet-liveness view
+    fleet_live = _fleet_live_pids()
     if sroot:
-        out.extend(_scan_spill_root(sroot))
+        out.extend(_scan_spill_root(sroot, fleet_live))
     if jroot:
-        out.extend(_scan_journal_root(jroot))
+        out.extend(_scan_journal_root(jroot, fleet_live))
     return out
 
 

@@ -260,7 +260,8 @@ def run_stream_pipeline(
     sinks become per-window part directories, and a re-issued spec with
     the same ``job_id`` resumes from the journaled boundary — or, when
     the job already completed, returns the journaled result without
-    executing a single window (exactly-once).  The returned per-window
+    executing a single window (exactly-once).  Both resume shapes mark
+    the reply with ``"resumed": True``.  The returned per-window
     ledger snapshots cover exactly the windows THIS run executed, so
     their counters still sum to the request's attribution ledger."""
     stages = list(stages or ())
@@ -532,6 +533,11 @@ def run_stream_pipeline(
         "frame": None,
         "sink": None,
     }
+    if start_window:
+        # mid-job adoption: boundaries journaled by a prior owner
+        # (possibly a dead process — fleet migration) were skipped,
+        # not re-executed
+        result["resumed"] = True
     if agg_stage is not None:
         result["frame"] = acc
     elif kind == "parquet":

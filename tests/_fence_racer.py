@@ -1,0 +1,109 @@
+"""Subprocess racer for the cross-process fence-adoption test
+(tests/test_fleet.py and the ``fleet`` CI tier).
+
+The parent launches TWO of these children against the same shared
+``TFS_JOURNAL_DIR`` and the same ``job_id`` — both alive, both running
+the identical durable ``reduce_rows`` over the parent's parquet
+fixture, each window slowed by ``delay_s`` so the second child adopts
+while the first is mid-job.  Adoption fences by construction
+(last-adopter-wins): exactly one child completes; the other's next
+journal append raises :class:`FenceLost` and it stops writing.  Each
+child prints exactly one JSON line on stdout::
+
+    {"outcome": "complete", "sha": ..., "value": ..., "counters": ...}
+    {"outcome": "fence_lost", "counters": ...}
+
+— result sha is byte-exact (sha256 over the raw reduced array), so the
+parent's bit-identity comparison against an uninterrupted reference is
+a string equality.
+
+Not a pytest file (leading underscore): pytest never collects it.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+# launched as `python tests/_fence_racer.py` — the script dir (tests/)
+# is on sys.path, the repo root is not; add it so the child imports the
+# tree under test
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("TFS_DEVICE_POOL", "0")
+os.environ.setdefault("TFS_BLOCK_RETRIES", "0")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+# mirror tests/conftest.py: cpu backend + x64 fidelity, so the child's
+# f64 results are byte-comparable across children and with the parent
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+WINDOW = 100
+
+
+def main() -> None:
+    src, job_id, delay_s = sys.argv[1], sys.argv[2], float(sys.argv[3])
+    from tensorframes_tpu import observability as obs
+    from tensorframes_tpu import streaming
+    from tensorframes_tpu.recovery import FenceLost
+
+    def source():
+        import pyarrow.parquet as pq
+
+        for b in pq.ParquetFile(src).iter_batches(batch_size=WINDOW):
+            time.sleep(delay_s)
+            yield b
+
+    stream = streaming.from_batches(source, window_rows=WINDOW)
+    c0 = obs.counters()
+    keep = (
+        "stream_windows",
+        "journal_appends",
+        "journal_windows_skipped",
+        "journal_resumes",
+        "journal_fence_rejections",
+    )
+    try:
+        out = streaming.reduce_rows(
+            lambda x_1, x_2: {"x": x_1 + x_2},
+            stream,
+            fetches=["x"],
+            job_id=job_id,
+        )
+    except FenceLost:
+        delta = obs.counters_delta(c0)
+        print(
+            json.dumps(
+                {
+                    "outcome": "fence_lost",
+                    "counters": {k: delta[k] for k in keep},
+                }
+            ),
+            flush=True,
+        )
+        return
+    a = np.ascontiguousarray(np.asarray(out["x"]))
+    delta = obs.counters_delta(c0)
+    print(
+        json.dumps(
+            {
+                "outcome": "complete",
+                "sha": hashlib.sha256(a.tobytes()).hexdigest(),
+                "value": float(a),
+                "counters": {k: delta[k] for k in keep},
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -160,6 +160,21 @@ os.environ.setdefault("TFS_JOURNAL_DIR", "")
 os.environ.setdefault("TFS_ANALYZE", "")
 os.environ.setdefault("TFS_ANALYZE_XCHECK", "")
 
+# Bridge fleet (round 21, tensorframes_tpu/bridge/fleet.py): no fleet
+# in the main suite — no registry dir (heartbeat files off), no replica
+# identity override, router knobs at their documented defaults.  The
+# fleet tests build routers/fleets with explicit constructor args;
+# run_tests.sh's fleet tier re-runs them with the registry + shared
+# journal/compile-cache dirs live (multi-process replicas, chaos leg).
+os.environ.setdefault("TFS_FLEET_SIZE", "")         # no ambient fleet size
+os.environ.setdefault("TFS_FLEET_REGISTRY", "")     # heartbeats off
+os.environ.setdefault("TFS_FLEET_REPLICA", "")      # no identity override
+os.environ.setdefault("TFS_FLEET_HEALTH_S", "")     # poll period: default
+os.environ.setdefault("TFS_FLEET_QUARANTINE_AFTER", "")  # flap threshold
+os.environ.setdefault("TFS_FLEET_QUARANTINE_S", "")      # hold: default
+# busy-retry hint cap (round 21): default cap, jitter unaffected
+os.environ.setdefault("TFS_BRIDGE_CLIENT_BUSY_CAP_MS", "")
+
 # Absence-default pins for every remaining TFS_* knob the package reads
 # (round 17; enforced by tools/tfs_lint.py rule `knob-pins`).  Each pin
 # is the knob's documented "unset" behavior — setdefault, so an

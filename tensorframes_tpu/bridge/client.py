@@ -829,6 +829,39 @@ class BridgeClient:
             r["frame"] = RemoteFrame(self, r["frame_id"], r["schema"])
         return r
 
+    def decode(
+        self,
+        prompt: Sequence[int],
+        max_new: int = 16,
+        speculative: bool = False,
+        gamma: int = 4,
+        stop_token: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Stream up to ``max_new`` greedy tokens continuing ``prompt``
+        through the server's paged decode scheduler (round 22).  The
+        request joins the RUNNING slot batch at the next step boundary
+        and retires the moment its stream finishes (``max_new`` reached
+        or ``stop_token`` emitted), freeing its KV pages immediately;
+        ``deadline_ms`` cancels at a step boundary.  Per-request
+        attribution applies: generated tokens bill this client's tenant.
+        ``speculative=True`` opts into the draft/verify path (needs a
+        draft model server-side; verified bit-exactly by the target
+        model).  Page-pool or slot exhaustion raises
+        :class:`ServerBusy` whose ``retry_after_ms`` says when to come
+        back — admission is refused up front, never OOMed mid-stream.
+        Returns ``{"tokens": [...], "generated": n, "speculative":
+        bool}``."""
+        return self.call(
+            "decode",
+            deadline_ms=deadline_ms,
+            prompt=[int(t) for t in prompt],
+            max_new=int(max_new),
+            speculative=bool(speculative),
+            gamma=int(gamma),
+            stop_token=None if stop_token is None else int(stop_token),
+        )
+
     def job_status(self, job_id: str) -> Dict[str, Any]:
         """Status of a durable job (round 20, ungated): whether the
         server's journal holds it, its completed-window boundary, and

@@ -76,6 +76,7 @@ import hashlib
 import logging
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
@@ -1205,3 +1206,550 @@ class _DecodeSlot:
         self.done = threading.Event()
         self.error: Optional[BaseException] = None
         self.abandoned = False
+
+
+# ---------------------------------------------------------------------------
+# paged continuous decode (round 22)
+# ---------------------------------------------------------------------------
+
+ENV_DECODE_MAX_SLOTS = "TFS_DECODE_MAX_SLOTS"
+DEFAULT_DECODE_MAX_SLOTS = 8
+# bounded retry against injected/real transient dispatch failures at a
+# step boundary — the functional (kp, vp, tables) state makes a retry
+# recompute the identical step
+_DECODE_STEP_ATTEMPTS = 3
+
+# live schedulers, weakly held: tfs.doctor() reads the first open one's
+# snapshot without the caller having to thread it through
+_LIVE_DECODE: "weakref.WeakSet[DecodeScheduler]" = weakref.WeakSet()
+
+
+def decode_doctor_snapshot() -> Optional[Dict[str, Any]]:
+    """Snapshot of the live :class:`DecodeScheduler`, if one exists —
+    the evidence feed for doctor's ``kv_fragmentation`` /
+    ``decode_slot_starvation`` rules (injectable there as
+    ``decode=``)."""
+    for sched in list(_LIVE_DECODE):
+        if not sched._closed:
+            return sched.snapshot()
+    return None
+
+
+class DecodeRefused(RuntimeError):
+    """Typed decode admission refusal: the page pool (``reason:
+    'pages'``) or the slot/backlog bound (``reason: 'slots'``) cannot
+    take the sequence now.  Carries ``retry_after_ms`` — the serving
+    layer maps this to ``server_busy`` so clients back off instead of
+    the scheduler OOMing mid-step."""
+
+    def __init__(self, reason: str, retry_after_ms: int, detail: str = ""):
+        self.reason = reason
+        self.retry_after_ms = int(retry_after_ms)
+        super().__init__(
+            f"decode admission refused ({reason}): "
+            f"{detail or 'resources exhausted'}; "
+            f"retry after {self.retry_after_ms}ms"
+        )
+
+
+class _PagedSeq:
+    """One admitted sequence: its prompt, page reservation, and stream
+    bookkeeping.  ``charge`` is the pool's pinned-budget handle — the
+    slot holds it (the budget LRU only holds a weakref) until the pages
+    are freed at retirement."""
+
+    __slots__ = (
+        "prompt", "max_new", "until", "tenant", "scope", "charge",
+        "table_row", "out", "emitted", "done", "error", "abandoned",
+    )
+
+    def __init__(self, prompt, max_new, until, tenant, scope, charge):
+        self.prompt = prompt  # np.int32 [Lp]
+        self.max_new = max(1, int(max_new))
+        self.until = until
+        self.tenant = tenant
+        self.scope = scope  # cancellation.CancelScope | None
+        self.charge = charge  # kv_pager._SeqPages
+        self.table_row = None  # np.int32 [max_pages], set at admission
+        self.out: List[int] = []
+        self.emitted = 0
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.abandoned = False
+
+
+class DecodeScheduler:
+    """Continuous decode over the PAGED KV cache (round 22): the
+    serving form of ``models/kv_pager.py``.
+
+    The ContinuousBatcher above batches opaque per-row step functions;
+    this scheduler owns the transformer serving path end to end — each
+    of its ``TFS_DECODE_MAX_SLOTS`` slots holds a page table into the
+    shared :class:`~..models.kv_pager.PagePool`, and the driver thread
+    alternates two fixed-shape compiled dispatches:
+
+    * **prefill lane** (disaggregated): sequences admitted at a step
+      boundary prefill together as one bucket-padded batch
+      (``ops/bucketing`` ladder — the same geometric ladder every verb
+      uses, so the executable grid stays bounded), writing their
+      prompts' KV straight into their reserved pages;
+    * **decode lane**: one ``[max_slots]``-shaped greedy step for the
+      whole population; slots join at step boundaries and retire the
+      moment their stream finishes (``max_new`` reached, ``until`` hit,
+      deadline expired, or caller abandoned), returning their pages to
+      the pool immediately — early retirement is what lets short
+      requests subsidise long ones under a fixed page budget.
+
+    Admission is synchronous and typed: ``submit`` reserves the FULL
+    page span (``ceil((Lp + max_new) / P)``) up front, so a sequence
+    that starts decoding can always finish — pool exhaustion surfaces
+    as :class:`DecodeRefused` with ``retry_after_ms`` at admission,
+    never as an OOM three steps into a stream.  Deadlines and cancels
+    (the request's :mod:`cancellation` scope, captured at submit) are
+    honoured at step boundaries, where retirement frees pages without
+    perturbing neighbors: per-row results are bit-identical to solo
+    ``decode.generate`` at the scheduler's capacity (rows under the
+    batched einsums are independent; masked slots carry exact-zero
+    weight; the attention reduction extent matches by construction).
+
+    ``speculative`` runs the draft/verify path (B=1 by its contract)
+    solo in the caller's thread — an opt-in per-request latency knob,
+    verified bit-exactly by the target model inside
+    ``decode.speculative_generate`` itself.
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg,
+        *,
+        max_slots: Optional[int] = None,
+        tokens_per_page: Optional[int] = None,
+        max_seq: Optional[int] = None,
+        pool_pages: Optional[int] = None,
+        draft_params=None,
+        draft_cfg=None,
+    ):
+        from ..models import decode as decode_mod
+        from ..models import kv_pager
+
+        self._kv = kv_pager
+        self._decode = decode_mod
+        self.cfg = cfg
+        self._raw_params = params  # speculative casts per-model itself
+        self._params = decode_mod.cast_params(params, cfg.dtype)
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.max_slots = max(
+            1,
+            int(max_slots)
+            if max_slots is not None
+            else _env_int(ENV_DECODE_MAX_SLOTS, DEFAULT_DECODE_MAX_SLOTS),
+        )
+        P = (
+            int(tokens_per_page)
+            if tokens_per_page is not None
+            else kv_pager.page_tokens()
+        )
+        cap = int(max_seq) if max_seq is not None else int(cfg.max_seq)
+        # capacity rounds UP to a whole page: the gathered attention
+        # extent is max_pages * P, and bit-identity vs the contiguous
+        # path is pinned at exactly this capacity (``cache_len=cap``)
+        self.max_pages = kv_pager.pages_for(cap, P)
+        self.cap = self.max_pages * P
+        n_pages = (
+            int(pool_pages)
+            if pool_pages is not None
+            else self.max_slots * self.max_pages + 1
+        )
+        self.pool = kv_pager.PagePool(cfg, n_pages, tokens_per_page=P)
+        self._kp = self.pool.k_pages
+        self._vp = self.pool.v_pages
+        self._tables = np.zeros(
+            (self.max_slots, self.max_pages), np.int32
+        )
+        self._indices = np.zeros((self.max_slots,), np.int32)
+        self._toks = np.zeros((self.max_slots,), np.int32)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: "collections.deque[_PagedSeq]" = collections.deque()
+        self._active: Dict[int, _PagedSeq] = {}
+        self._free = list(range(self.max_slots))
+        self._driver: Optional[threading.Thread] = None
+        self._closed = False
+        # telemetry (guarded by _lock where racy)
+        self.steps = 0
+        self.joined_mid_run = 0
+        self.retired = 0
+        self.total_tokens = 0
+        self.prefill_batches = 0
+        self.refusals = {"pages": 0, "slots": 0}
+        # refusals issued while at least one slot sat idle: the bound
+        # (pool size / backlog cap), not compute, was the limit — the
+        # decode_slot_starvation doctor rule's evidence
+        self.refused_while_idle = 0
+        _LIVE_DECODE.add(self)
+
+    # -- public --------------------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new: int,
+        until: Optional[Callable[[int], bool]] = None,
+        tenant: Optional[str] = None,
+        timeout_s: Optional[float] = None,
+    ) -> List[int]:
+        """Stream up to ``max_new`` greedy tokens continuing ``prompt``
+        (1-D int array).  Joins the running batch at the next step
+        boundary; blocks until the stream retires and returns the
+        emitted tokens.  Raises :class:`DecodeRefused` when the page
+        pool or the slot backlog cannot take the sequence."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("decode needs a non-empty prompt")
+        max_new = max(1, int(max_new))
+        total = int(prompt.size) + max_new
+        if total > self.cap:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new {max_new} exceeds the "
+                f"scheduler capacity {self.cap} tokens"
+            )
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("DecodeScheduler is closed")
+            # bounded backlog: refusing here (with a hint) beats an
+            # unbounded queue whose tail waits out every stream ahead
+            if len(self._pending) + len(self._active) >= 2 * self.max_slots:
+                self.refusals["slots"] += 1
+                if len(self._active) < self.max_slots:
+                    self.refused_while_idle += 1
+                raise DecodeRefused(
+                    "slots",
+                    retry_after_ms=100 * max(1, len(self._pending)),
+                    detail=(
+                        f"{len(self._active)} active + "
+                        f"{len(self._pending)} pending vs "
+                        f"{self.max_slots} slots"
+                    ),
+                )
+        # reserve the FULL span up front — outside the scheduler lock
+        # (the pool has its own) so a slow budget walk never stalls the
+        # step loop
+        try:
+            charge, pages = self.pool.allocate(
+                self._kv.pages_for(total, self.pool.tokens_per_page),
+                tenant=tenant,
+            )
+        except self._kv.PagesExhausted as e:
+            with self._cv:
+                self.refusals["pages"] += 1
+                if len(self._active) < self.max_slots:
+                    self.refused_while_idle += 1
+            raise DecodeRefused(
+                "pages", e.retry_after_ms, detail=str(e)
+            ) from e
+        req = _PagedSeq(
+            prompt, max_new, until, tenant,
+            cancellation.current_scope(), charge,
+        )
+        row = np.zeros((self.max_pages,), np.int32)
+        row[: len(pages)] = pages
+        req.table_row = row
+        with self._cv:
+            if self._closed:
+                self.pool.free(charge)
+                raise RuntimeError("DecodeScheduler is closed")
+            self._pending.append(req)
+            self._ensure_driver()
+            self._cv.notify_all()
+        if not req.done.wait(timeout=timeout_s):
+            with self._cv:
+                req.abandoned = True
+                self._cv.notify_all()
+            raise TimeoutError(
+                f"decode request did not finish within {timeout_s}s"
+            )
+        if req.error is not None:
+            raise req.error
+        return req.out
+
+    def speculative(
+        self,
+        prompt,
+        max_new: int,
+        gamma: int = 4,
+        tenant: Optional[str] = None,
+    ) -> List[int]:
+        """Opt-in per-request speculative decoding: the draft model
+        proposes, the target verifies bit-exactly
+        (``decode.speculative_generate``).  Runs solo in the caller's
+        thread — B=1 by the draft/verify contract — so it never blocks
+        the batch; greedy output equals the batched path's."""
+        if self.draft_params is None or self.draft_cfg is None:
+            raise ValueError(
+                "speculative decode needs a draft model "
+                "(DecodeScheduler(draft_params=..., draft_cfg=...))"
+            )
+        import jax.numpy as jnp
+
+        prompt = np.asarray(prompt, np.int32).reshape(1, -1)
+        out = self._decode.speculative_generate(
+            self.draft_params, self.draft_cfg,
+            self._raw_params, self.cfg,
+            jnp.asarray(prompt), int(max_new), gamma=int(gamma),
+        )
+        toks = [int(t) for t in np.asarray(out)[0, prompt.shape[1]:]]
+        with self._cv:
+            self.total_tokens += len(toks)
+        observability.note_decode_tokens(len(toks))
+        return toks
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._driver is not None:
+            self._driver.join(timeout=5.0)
+
+    # -- telemetry -----------------------------------------------------------
+
+    def gauges(self) -> Dict[str, float]:
+        """The ``tfs_kv_pages`` gauge family (grouped provider)."""
+        stats = self.pool.stats()
+        with self._lock:
+            active, pending = len(self._active), len(self._pending)
+        return {
+            "tfs_kv_pages_free": float(stats["pages_free"]),
+            "tfs_kv_pages_used": float(stats["pages_used"]),
+            "tfs_kv_pages_capacity": float(stats["pages_total"]),
+            "tfs_decode_slots_active": float(active),
+            "tfs_decode_slots_free": float(self.max_slots - active),
+            "tfs_decode_pending": float(pending),
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        stats = self.pool.stats()
+        with self._lock:
+            return {
+                "max_slots": self.max_slots,
+                "cap_tokens": self.cap,
+                "page_tokens": self.pool.tokens_per_page,
+                "active": len(self._active),
+                "pending": len(self._pending),
+                "steps": self.steps,
+                "retired": self.retired,
+                "joined_mid_run": self.joined_mid_run,
+                "total_tokens": self.total_tokens,
+                "prefill_batches": self.prefill_batches,
+                "refused_pages": self.refusals["pages"],
+                "refused_slots": self.refusals["slots"],
+                "refused_while_idle": self.refused_while_idle,
+                "pages_free": stats["pages_free"],
+                "pages_used": stats["pages_used"],
+                "pages_capacity": stats["pages_total"],
+                "pages_allocated_total": stats["allocated_total"],
+                "pages_freed_total": stats["freed_total"],
+            }
+
+    # -- driver --------------------------------------------------------------
+
+    def _ensure_driver(self) -> None:
+        if self._driver is None or not self._driver.is_alive():
+            self._driver = threading.Thread(
+                target=self._drive, name="tfs-paged-decode", daemon=True
+            )
+            self._driver.start()
+
+    def _retire_locked(self, slot: int, req: _PagedSeq) -> None:
+        """Free a slot at a step boundary: pages back to the pool, the
+        table row back to all-trash (so the slot's idle writes land on
+        page 0), the waiter released.  Holding the lock is fine — the
+        pool lock nests under no other."""
+        del self._active[slot]
+        self._free.append(slot)
+        self._tables[slot] = 0
+        self._indices[slot] = 0
+        self._toks[slot] = 0
+        self.retired += 1
+        self.pool.free(req.charge)
+        req.done.set()
+
+    def _dispatch(self, fn, *args):
+        """One compiled dispatch with chaos injection + bounded retry:
+        ``faults.maybe_inject`` fires configured transients at the step
+        boundary (site='dispatch', so attempt selectors work), and the
+        functional (pages, tables, tokens) state means a retry
+        recomputes the identical step."""
+        from .. import faults
+
+        attempt = 0
+        while True:
+            try:
+                faults.maybe_inject(self.steps, attempt, site="dispatch")
+                return fn(*args)
+            except faults.InjectedTransient:
+                attempt += 1
+                if attempt >= _DECODE_STEP_ATTEMPTS:
+                    raise
+
+    def _drive(self) -> None:
+        import jax.numpy as jnp
+
+        kv = self._kv
+        try:
+            while True:
+                with self._cv:
+                    while (
+                        not self._closed
+                        and not self._pending
+                        and not self._active
+                    ):
+                        self._cv.wait()
+                    if self._closed and not self._active:
+                        err = RuntimeError(
+                            "DecodeScheduler closed before this "
+                            "request was admitted"
+                        )
+                        for req in self._pending:
+                            self.pool.free(req.charge)
+                            req.error = err
+                            req.done.set()
+                        self._pending.clear()
+                        return
+                    # step boundary: deadline/cancel checks retire
+                    # expired rows and free their pages BEFORE admission
+                    # (their slots are immediately reusable)
+                    for slot, req in list(self._active.items()):
+                        if req.abandoned:
+                            self._retire_locked(slot, req)
+                            continue
+                        if req.scope is not None:
+                            try:
+                                req.scope.check()
+                            except cancellation.Cancelled as e:
+                                req.error = e
+                                self._retire_locked(slot, req)
+                                observability.note_bridge_deadline_exceeded()
+                    was_running = bool(self._active)
+                    admitted: List[Tuple[int, _PagedSeq]] = []
+                    while self._pending and self._free:
+                        req = self._pending.popleft()
+                        if req.abandoned:
+                            self.pool.free(req.charge)
+                            req.done.set()
+                            continue
+                        slot = self._free.pop()
+                        self._tables[slot] = req.table_row
+                        self._indices[slot] = 0
+                        self._active[slot] = req
+                        admitted.append((slot, req))
+                        if was_running:
+                            self.joined_mid_run += 1
+                    active = bool(self._active)
+                if not active:
+                    continue
+                if admitted:
+                    self._prefill(admitted, jnp)
+                    # prefill may retire 1-token streams at once; the
+                    # boundary loop re-checks before the next step
+                    with self._cv:
+                        for slot, req in admitted:
+                            if slot in self._active and (
+                                req.emitted >= req.max_new
+                                or (
+                                    req.until is not None
+                                    and req.out
+                                    and bool(req.until(req.out[-1]))
+                                )
+                            ):
+                                self._retire_locked(slot, req)
+                        if not self._active:
+                            continue
+                # decode lane: one fixed-shape step for the population
+                toks, self._kp, self._vp = self._dispatch(
+                    kv.paged_decode_step,
+                    self._params,
+                    jnp.asarray(self._toks),
+                    jnp.asarray(self._tables),
+                    jnp.asarray(self._indices),
+                    self._kp,
+                    self._vp,
+                    self.cfg,
+                )
+                emitted = np.asarray(toks)
+                self.steps += 1
+                with self._cv:
+                    for slot, req in list(self._active.items()):
+                        self._indices[slot] += 1
+                        tok = int(emitted[slot])
+                        self._toks[slot] = tok
+                        req.out.append(tok)
+                        req.emitted += 1
+                        self.total_tokens += 1
+                        observability.note_decode_tokens(1)
+                        stop = req.emitted >= req.max_new or (
+                            req.until is not None and bool(req.until(tok))
+                        )
+                        if stop or req.abandoned:
+                            self._retire_locked(slot, req)
+                    # idle slots keep index 0 / token 0: their writes
+                    # land on the trash page via their all-zero tables
+        except BaseException as e:  # noqa: BLE001 — fail every waiter
+            with self._cv:
+                for req in list(self._active.values()):
+                    self.pool.free(req.charge)
+                    req.error = e
+                    req.done.set()
+                for req in self._pending:
+                    self.pool.free(req.charge)
+                    req.error = e
+                    req.done.set()
+                self._active.clear()
+                self._pending.clear()
+                self._free = list(range(self.max_slots))
+                self._tables[:] = 0
+                self._indices[:] = 0
+                self._toks[:] = 0
+
+    def _prefill(self, admitted, jnp) -> None:
+        """The disaggregated prefill lane: the boundary's newly admitted
+        sequences prefill as ONE bucket-padded batch through the
+        existing ladder.  Rows not being prefilled ride along with
+        all-trash tables (their live tables stay untouched — prefill
+        writes only through the batch's own table argument)."""
+        kv = self._kv
+        max_lp = max(int(r.prompt.size) for _, r in admitted)
+        lb = min(max(bucketing.bucket_for(max_lp), 1), self.cap)
+        lb = max(lb, max_lp)
+        toks = np.zeros((self.max_slots, lb), np.int32)
+        tables = np.zeros((self.max_slots, self.max_pages), np.int32)
+        last_pos = np.zeros((self.max_slots,), np.int32)
+        for slot, req in admitted:
+            lp = int(req.prompt.size)
+            toks[slot, :lp] = req.prompt
+            tables[slot] = req.table_row
+            last_pos[slot] = lp - 1
+        tok0, self._kp, self._vp = self._dispatch(
+            kv.paged_prefill,
+            self._params,
+            jnp.asarray(toks),
+            jnp.asarray(tables),
+            jnp.asarray(last_pos),
+            self._kp,
+            self._vp,
+            self.cfg,
+        )
+        tok0 = np.asarray(tok0)
+        self.prefill_batches += 1
+        observability.note_decode_prefill_batch()
+        with self._cv:
+            for slot, req in admitted:
+                lp = int(req.prompt.size)
+                self._indices[slot] = lp
+                tok = int(tok0[slot])
+                self._toks[slot] = tok
+                req.out.append(tok)
+                req.emitted += 1
+                self.total_tokens += 1
+                observability.note_decode_tokens(1)

@@ -25,6 +25,13 @@ is how a fleet router tells a restarted replica from a recovered one),
 and ``health``'s ``scheduler`` object gains ``"p99_ms"``.  Old clients
 ignore the extra keys; old servers simply omit them (clients treat a
 missing ``"replica"`` as a pre-fleet server) — the version stays 2.
+Round 22 adds one METHOD, not a wire change: ``decode`` (gated, billed)
+takes ``{"prompt": [ints], "max_new", "speculative", "gamma",
+"stop_token"}`` and returns ``{"tokens": [ints], "generated",
+"speculative"}``; page-pool exhaustion answers with the existing
+``server_busy`` error shape (``retry_after_ms`` + a ``"reason"`` of
+``"pages"``/``"slots"``), and ``health`` gains a ``"decode"`` object —
+all additive, so the version stays 2 here too.
 Small tensors ride inline as ``{"__tensor__": {"dtype", "shape",
 "data"(b64)}}``; binary cells as ``{"__bytes__": b64}``.
 

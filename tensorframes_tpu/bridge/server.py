@@ -140,6 +140,11 @@ _GATED_METHODS = frozenset(
         # request's cancel scope (checkpointed at every window
         # boundary), and attributes per window through nested ledgers
         "pipeline",
+        # round 22: paged continuous decode — joins the running slot
+        # batch at a step boundary, bills generated tokens per tenant,
+        # honours deadline/cancel at step boundaries, and surfaces
+        # page-pool exhaustion as a typed server_busy refusal
+        "decode",
     }
 )
 
@@ -1203,6 +1208,8 @@ class _Handler(socketserver.StreamRequestHandler):
                                 result = sess.run_row_verb(method, **params)
                             elif method == "warm":
                                 result = server.warm_program(**params)
+                            elif method == "decode":
+                                result = server.run_decode(**params)
                             else:  # create_frame / analyze / collect
                                 result = getattr(sess, method)(**params)
                             server._note_usage(sess, method, params)
@@ -1287,6 +1294,7 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         fair_rows: Optional[int] = None,
         fair_window_s: Optional[float] = None,
         slo_ms: Optional[float] = None,
+        decode_model: Optional[Dict[str, Any]] = None,
     ):
         if not allow_remote and host not in ("127.0.0.1", "::1", "localhost"):
             raise ValueError(
@@ -1338,6 +1346,18 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         self.scheduler = _coalescer.SloScheduler(
             fair_rows=fair_rows, window_s=fair_window_s, slo_ms=slo_ms
         )
+        # round 22 — paged continuous decode: a server given a model
+        # (``decode_model={"params": ..., "cfg": ..., [draft_params,
+        # draft_cfg, max_slots, tokens_per_page, max_seq, pool_pages]}``)
+        # serves the gated ``decode`` RPC through a DecodeScheduler
+        # whose slots hold page tables into one shared PagePool; no
+        # model configured = the method refuses with a typed error
+        self.decode_scheduler = None
+        if decode_model is not None:
+            dm = dict(decode_model)
+            self.decode_scheduler = _coalescer.DecodeScheduler(
+                dm.pop("params"), dm.pop("cfg"), **dm
+            )
         # round 21 — stable replica identity: pid + a start-time epoch
         # token.  The NAME is stable across restarts (the fleet spawner
         # pins it via TFS_FLEET_REPLICA); the EPOCH changes every start,
@@ -1399,6 +1419,12 @@ class BridgeServer(socketserver.ThreadingTCPServer):
             # (one snapshot per scrape, no counter-name collisions)
             "tfs_bridge_coalescer": self.coalescer.gauges,
         }
+        if self.decode_scheduler is not None:
+            # round 22: the tfs_kv_pages gauge family (pool occupancy +
+            # slot population) — grouped, one snapshot per scrape
+            self._gauge_providers["tfs_kv_pages"] = (
+                self.decode_scheduler.gauges
+            )
         for name, fn in self._gauge_providers.items():
             observability.register_gauge(name, fn)
         observability.maybe_start_metrics_server()
@@ -1561,6 +1587,12 @@ class BridgeServer(socketserver.ThreadingTCPServer):
             "reduce_blocks",
             "reduce_rows",
             "collect",
+            # round 22: decode bills GENERATED TOKENS (not frame rows)
+            # to the tenant's fair-share window — the billing happens in
+            # run_decode once the count is known; membership here puts
+            # decode under the SLO scheduler's shed policy like every
+            # other compute verb
+            "decode",
         }
     )
 
@@ -1685,6 +1717,76 @@ class BridgeServer(socketserver.ThreadingTCPServer):
             "resident": len(self.coalescer.warm),
         }
 
+    def run_decode(
+        self,
+        prompt=None,
+        max_new: int = 16,
+        speculative: bool = False,
+        gamma: int = 4,
+        stop_token: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """The gated ``decode`` RPC (round 22): stream ``max_new``
+        greedy tokens continuing ``prompt`` through the paged decode
+        scheduler.  The request joins the running slot batch at the
+        next step boundary; its cancel scope (deadline/cancel/drain) is
+        honoured at step boundaries, where retirement frees the
+        sequence's KV pages.  ``speculative=True`` opts this request
+        into the draft/verify path (needs a draft model configured;
+        runs solo — B=1 by its contract — and is verified bit-exactly
+        by the target model).  Generated tokens bill the tenant's
+        fair-share window; page-pool/slot exhaustion surfaces as
+        ``server_busy`` with ``retry_after_ms``."""
+        sched = self.decode_scheduler
+        if sched is None:
+            raise BridgeServerError(
+                "this server has no decode model configured "
+                "(BridgeServer(decode_model={'params': ..., 'cfg': ...}))",
+                code="decode_unavailable",
+            )
+        prompt = np.asarray(prompt if prompt is not None else [], np.int64)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise BridgeServerError(
+                "decode needs prompt=[t0, t1, ...] (a non-empty 1-D "
+                "token list)",
+                code="bad_request",
+            )
+        led = observability.current_request()
+        tenant = led.tenant if led is not None else None
+        until = (
+            (lambda t, s=int(stop_token): t == s)
+            if stop_token is not None
+            else None
+        )
+        try:
+            if speculative:
+                toks = sched.speculative(
+                    prompt, int(max_new), gamma=int(gamma), tenant=tenant
+                )
+                if until is not None:
+                    for i, t in enumerate(toks):
+                        if until(t):
+                            toks = toks[: i + 1]
+                            break
+            else:
+                toks = sched.submit(
+                    prompt, int(max_new), until=until, tenant=tenant
+                )
+        except _coalescer.DecodeRefused as e:
+            raise ServerBusy(
+                str(e),
+                retry_after_ms=e.retry_after_ms,
+                reason=e.reason,
+            ) from e
+        # tokens are the work decode put on the machine — the billing
+        # unit for its fair-share window (frame verbs bill rows)
+        if self.scheduler.enabled():
+            self.scheduler.note(tenant, len(toks))
+        return {
+            "tokens": [int(t) for t in toks],
+            "generated": len(toks),
+            "speculative": bool(speculative),
+        }
+
     # -- health --------------------------------------------------------------
 
     def replica_identity(self) -> Dict[str, Any]:
@@ -1730,6 +1832,13 @@ class BridgeServer(socketserver.ThreadingTCPServer):
             # per-tenant window usage) for serving dashboards/balancers
             "coalescer": self.coalescer.snapshot(),
             "scheduler": self.scheduler.snapshot(),
+            # round 22: paged-decode population + page-pool occupancy
+            # (None when no decode model is configured)
+            "decode": (
+                self.decode_scheduler.snapshot()
+                if self.decode_scheduler is not None
+                else None
+            ),
             # round 20: what the startup janitor found — whether a
             # journal is configured, the resumable jobs dead processes
             # left, and the stale bytes reclaimed at start
@@ -1764,6 +1873,12 @@ class BridgeServer(socketserver.ThreadingTCPServer):
                     "fleet_jobs_migrated",
                     "fleet_quarantines",
                     "fleet_replica_restarts",
+                    # round 22: paged-decode acceptance evidence —
+                    # tokens served, page churn, prefill batching
+                    "decode_tokens",
+                    "kv_pages_allocated",
+                    "kv_pages_freed",
+                    "decode_prefill_batches",
                 )
             },
             # round 13: the gauge snapshot serving operators need
@@ -1872,6 +1987,11 @@ class BridgeServer(socketserver.ThreadingTCPServer):
             # boundary, which does not scale with the drain budget —
             # close() is bounded by budget + 1s, not 2x budget
             self.gate.wait_idle(1.0)
+        if self.decode_scheduler is not None:
+            # after the gate drained/cancelled: in-flight decode
+            # requests' scopes were cancelled above, so the driver
+            # retires them (freeing their pages) at its next boundary
+            self.decode_scheduler.close()
         self.shutdown()
         self.server_close()
 
@@ -1888,9 +2008,10 @@ def serve(
     thread and returns immediately (``server.address`` has the bound
     port).  ``server_kw`` forwards the resilience knobs
     (``max_inflight``, ``queue_depth``, ``drain_s``, ``max_frames``,
-    ``session_ttl_s``) and the round-16 serving knobs (``coalesce_us``,
+    ``session_ttl_s``), the round-16 serving knobs (``coalesce_us``,
     ``coalesce_rows``, ``warm_spec``, ``fair_rows``, ``fair_window_s``,
-    ``slo_ms``) past their env defaults."""
+    ``slo_ms``), and the round-22 paged-decode model (``decode_model``)
+    past their env defaults."""
     server = BridgeServer(
         host, port, engine=engine, allow_remote=allow_remote, **server_kw
     )

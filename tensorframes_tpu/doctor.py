@@ -557,6 +557,81 @@ def _rule_indep_probe_churn(c) -> Optional[Dict[str, Any]]:
     )
 
 
+KV_CHURN_PAGES = 8.0  # pages cycled per retired stream before "churn"
+
+
+def _rule_kv_fragmentation(c, decode) -> Optional[Dict[str, Any]]:
+    """The paged decode scheduler (round 22) is cycling many small KV
+    pages per stream while the pool sits mostly idle: the page size is
+    minting allocation/free traffic and page-table entries without the
+    pool being under capacity pressure.  Larger pages cut the churn;
+    the capacity cost (internal fragmentation of the last page per
+    stream) is what the low occupancy says the pool can afford."""
+    if not decode:
+        return None
+    freed = c.get("kv_pages_freed", 0)
+    retired = int(decode.get("retired") or 0)
+    if freed < MIN_EVENTS or retired < 1:
+        return None
+    pages_per_seq = freed / retired
+    cap = int(decode.get("pages_capacity") or 0)
+    occ = (decode.get("pages_used") or 0) / cap if cap else 0.0
+    if pages_per_seq < KV_CHURN_PAGES or occ >= OCCUPANCY_FLOOR:
+        return None
+    return _diag(
+        "kv_fragmentation",
+        "info",
+        f"paged decode cycled {freed} KV pages over {retired} retired "
+        f"stream(s) ({pages_per_seq:.1f} pages/stream at "
+        f"{decode.get('page_tokens')} tokens/page) while the pool sits "
+        f"at {occ:.0%} occupancy — page bookkeeping, not capacity, is "
+        f"the overhead",
+        {"kv_pages_freed": freed, "retired": retired,
+         "pages_per_stream": round(pages_per_seq, 2),
+         "page_tokens": decode.get("page_tokens"),
+         "pages_used": decode.get("pages_used"),
+         "pages_capacity": cap},
+        "TFS_DECODE_PAGE_TOKENS",
+        "raise TFS_DECODE_PAGE_TOKENS so each stream spans fewer pages "
+        "(fewer allocate/free cycles and smaller page tables); the "
+        "trade is internal fragmentation of each stream's last page, "
+        "which the idle pool absorbs — revisit if occupancy later "
+        "climbs past the floor",
+    )
+
+
+def _rule_decode_slot_starvation(c, decode) -> Optional[Dict[str, Any]]:
+    """Decode admissions were refused while slots sat idle (round 22):
+    the configured bounds — the page pool sized off
+    ``TFS_DECODE_MAX_SLOTS``, or the backlog cap at twice it — turned
+    work away that idle compute could have taken."""
+    if not decode:
+        return None
+    idle_refusals = int(decode.get("refused_while_idle") or 0)
+    if idle_refusals < MIN_EVENTS:
+        return None
+    return _diag(
+        "decode_slot_starvation",
+        "warn",
+        f"{idle_refusals} decode admission refusal(s) were issued "
+        f"while at least one of {decode.get('max_slots')} slots sat "
+        f"idle (pages: {decode.get('refused_pages')}, backlog: "
+        f"{decode.get('refused_slots')}) — the bounds, not compute, "
+        f"are the limit",
+        {"refused_while_idle": idle_refusals,
+         "refused_pages": decode.get("refused_pages"),
+         "refused_slots": decode.get("refused_slots"),
+         "max_slots": decode.get("max_slots"),
+         "pages_capacity": decode.get("pages_capacity")},
+        "TFS_DECODE_MAX_SLOTS",
+        "raise TFS_DECODE_MAX_SLOTS (the default page pool scales with "
+        "it, so both the backlog cap and page capacity grow), or pass "
+        "a larger pool_pages explicitly if only the pool is tight — "
+        "admission stays refusal-based either way, so decode still "
+        "cannot OOM mid-step",
+    )
+
+
 FLEET_IMBALANCE_RATIO = 4.0  # busiest replica's sessions vs fleet mean
 
 
@@ -650,6 +725,7 @@ def doctor(
     plans: Optional[Sequence[Mapping[str, Any]]] = None,
     artifacts: Optional[Mapping[str, Any]] = None,
     fleet: Optional[Mapping[str, Any]] = None,
+    decode: Optional[Mapping[str, Any]] = None,
 ) -> List[Dict[str, Any]]:
     """Diagnose the process's (or the given snapshots') performance
     state.  Returns structured diagnostics, worst first — each names
@@ -664,7 +740,9 @@ def doctor(
     request; ``spans`` takes :func:`observability.last_spans` records
     for measured pool occupancy; ``tenants`` takes
     :func:`observability.request_metrics` (or the server's
-    ``tfs_request_*`` scrape) for the fairness rule."""
+    ``tfs_request_*`` scrape) for the fairness rule; ``decode`` takes a
+    ``DecodeScheduler.snapshot()`` (or the ``health`` RPC's ``decode``
+    object) for the paged-decode rules."""
     c = dict(counters if counters is not None else observability.counters())
     lat = dict(
         latency if latency is not None else observability.latency_snapshot()
@@ -701,6 +779,13 @@ def doctor(
             fleet = _fleet_mod.doctor_snapshot() or {}
         except Exception:  # noqa: BLE001 — diagnosis must never fail here
             fleet = {}
+    if decode is None:
+        try:  # round 22: the live paged decode scheduler, when one exists
+            from .bridge import coalescer as _coalescer_mod
+
+            decode = _coalescer_mod.decode_doctor_snapshot() or {}
+        except Exception:  # noqa: BLE001 — diagnosis must never fail here
+            decode = {}
     out: List[Dict[str, Any]] = []
     for rule in (
         lambda: _rule_shed_burn(c),
@@ -717,6 +802,8 @@ def doctor(
         lambda: _rule_replica_flap(fleet),
         lambda: _rule_fleet_imbalance(fleet),
         lambda: _rule_indep_probe_churn(c),
+        lambda: _rule_kv_fragmentation(c, decode),
+        lambda: _rule_decode_slot_starvation(c, decode),
         lambda: _rule_slow_tail(lat),
     ):
         d = rule()

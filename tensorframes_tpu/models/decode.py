@@ -194,12 +194,12 @@ def sample_logits(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "cfg", "max_new_tokens", "top_k", "greedy", "nucleus",
+        "cfg", "max_new_tokens", "top_k", "greedy", "nucleus", "cache_len",
     ),
 )
 def _generate_jit(
     params, prompt, rng, temperature, top_p,
-    cfg, max_new_tokens, top_k, greedy, nucleus,
+    cfg, max_new_tokens, top_k, greedy, nucleus, cache_len=None,
 ):
     """The whole generation — weight cast, prefill, scanned decode — as
     ONE compiled dispatch (the eager per-op prefill used to dominate
@@ -209,10 +209,15 @@ def _generate_jit(
     token count, ``top_k``, greedy/nucleus flags); ``temperature`` and
     ``top_p`` flow through as traced scalars, so a sampling-parameter
     sweep reuses one executable instead of recompiling the model per
-    value."""
+    value.  ``cache_len`` overrides the exact-fit cache capacity —
+    the paged-decode suite compares against this path at the paged
+    scheduler's capacity, since the attention reduction extent must
+    match for bit-identity (slots past the frontier carry exact-zero
+    softmax weight, but a different extent changes accumulation
+    grouping)."""
     B, Lp = prompt.shape
     params = cast_params(params, cfg.dtype)
-    cache = init_cache(cfg, B, Lp + max_new_tokens)
+    cache = init_cache(cfg, B, cache_len or (Lp + max_new_tokens))
 
     def sample(logits_last, key):
         if greedy:
@@ -251,6 +256,7 @@ def generate(
     top_k: int = 0,
     top_p: float = 1.0,
     rng: Optional[jax.Array] = None,
+    cache_len: Optional[int] = None,
 ) -> jnp.ndarray:
     """Autoregressive continuation: prompt [B, Lp] -> [B, Lp + new].
 
@@ -262,6 +268,11 @@ def generate(
     dispatch + one readback regardless of token count."""
     if max_new_tokens <= 0:
         return prompt
+    if cache_len is not None and cache_len < prompt.shape[1] + max_new_tokens:
+        raise ValueError(
+            f"cache_len {cache_len} cannot hold prompt "
+            f"{prompt.shape[1]} + {max_new_tokens} new tokens"
+        )
     if rng is None:
         rng = jax.random.PRNGKey(0)
     from .. import observability
@@ -280,6 +291,7 @@ def generate(
             int(top_k),
             greedy=float(temperature) == 0.0,
             nucleus=float(top_p) < 1.0,
+            cache_len=None if cache_len is None else int(cache_len),
         )
         span.mark("dispatch")
         return out

@@ -148,6 +148,14 @@ class TransformerConfig:
         return self.d_model // self.n_heads
 
 
+def _abstract_mesh():
+    """The ambient abstract mesh, or None on jax versions without the
+    ``get_abstract_mesh`` API (constraints then no-op: those versions
+    have no ambient-mesh context for them to bind against either)."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    return get() if get is not None else None
+
+
 def shard(x: jnp.ndarray, *spec) -> jnp.ndarray:
     """Constrain ``x``'s sharding against the ambient mesh.
 
@@ -160,7 +168,7 @@ def shard(x: jnp.ndarray, *spec) -> jnp.ndarray:
         raise ValueError(
             f"shard: {len(spec)} spec entries for a rank-{x.ndim} array"
         )
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = _abstract_mesh()
     if mesh is None or not mesh.axis_names:
         return x
     # axes already bound as Manual (we are inside a shard_map over them,
@@ -363,31 +371,20 @@ def _block(
     (f32 scalar, 0 for dense blocks) — or ``(x', (ck, cv), aux)`` when
     caching."""
     x, cache = _attn_residual(bp, x, positions, cfg, kv, segments)
-    dt = cfg.dtype
-
     # -- MLP: dense SwiGLU or mixture of experts ----------------------------
-    y = _saved(_rms_norm(x, bp["ln2"]))
-    if cfg.moe_experts:
-        from .moe import moe_mlp
-
-        ff_out, aux = moe_mlp(bp, y, cfg, segments)
-        x = x + ff_out
-    else:
-        gate = jax.nn.silu(y @ weight(bp["w_gate"], dt))
-        up = y @ weight(bp["w_up"], dt)
-        ff = _saved(shard(gate * up, ("dp", "ep"), "sp", "tp"))
-        x = x + shard(ff @ weight(bp["w_down"], dt), ("dp", "ep"), "sp", None)
-        aux = jnp.zeros((), jnp.float32)
+    x, aux = _mlp_residual(bp, x, cfg, segments)
     if kv is not None:
         return x, cache, aux
     return x, aux
 
 
-def _attn_residual(bp, x, positions, cfg, kv=None, segments=None):
-    """The attention half of a block: x -> x + Wo(attn(...)).  Returns
-    ``(x', cache)`` (cache None outside decode).  Split out of ``_block``
-    so diagnostics (``moe.layer_routing_stats``) can reproduce the EXACT
-    activations the MLP half routes."""
+def _attn_qkv(bp, x, positions, cfg):
+    """The projection half of attention shared by every cache layout:
+    rms_norm -> q/k/v projections -> RoPE -> layout shards.  Returns
+    ``(q [B, L, h, Dh], k [B, L, kvh, Dh], v [B, L, kvh, Dh])``.  Split
+    out (round 22) so the paged KV cache (``models/kv_pager.py``) runs
+    the EXACT ops of the contiguous path — bit-identity between the two
+    cache layouts is by construction, not by parallel maintenance."""
     B, L, D = x.shape
     h, kvh, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
@@ -402,14 +399,51 @@ def _attn_residual(bp, x, positions, cfg, kv=None, segments=None):
         shard(_rope(k, positions, cfg.rope_theta), ("dp", "ep"), "sp", "tp", None)
     )
     v = _saved(shard(v, ("dp", "ep"), "sp", "tp", None))
-    from ..parallel.ring import full_attention, ring_attention
+    return q, k, v
 
+
+def _mlp_residual(bp, x, cfg, segments=None):
+    """The MLP half of a block: x -> x + FF(rms_norm(x)).  Returns
+    ``(x', aux)`` — aux is the MoE load-balance loss (0 for dense).
+    Split out of ``_block`` (round 22) so the paged decode block
+    (``models/kv_pager.py``) composes the same halves in the same
+    order."""
+    dt = cfg.dtype
+    y = _saved(_rms_norm(x, bp["ln2"]))
+    if cfg.moe_experts:
+        from .moe import moe_mlp
+
+        ff_out, aux = moe_mlp(bp, y, cfg, segments)
+        x = x + ff_out
+    else:
+        gate = jax.nn.silu(y @ weight(bp["w_gate"], dt))
+        up = y @ weight(bp["w_up"], dt)
+        ff = _saved(shard(gate * up, ("dp", "ep"), "sp", "tp"))
+        x = x + shard(ff @ weight(bp["w_down"], dt), ("dp", "ep"), "sp", None)
+        aux = jnp.zeros((), jnp.float32)
+    return x, aux
+
+
+def _attn_residual(bp, x, positions, cfg, kv=None, segments=None):
+    """The attention half of a block: x -> x + Wo(attn(...)).  Returns
+    ``(x', cache)`` (cache None outside decode).  Split out of ``_block``
+    so diagnostics (``moe.layer_routing_stats``) can reproduce the EXACT
+    activations the MLP half routes."""
+    B, L, D = x.shape
+    h, kvh, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+    q, k, v = _attn_qkv(bp, x, positions, cfg)
+    # the parallel package imports lazily and only on the paths that use
+    # it: the decode (kv) branch must stay importable on jax builds whose
+    # mesh API the distributed stack needs is absent
     if kv is not None:
         ck, cv, idx = kv
         ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), idx, 1)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), idx, 1)
         att = _cache_attention(q, ck.astype(dt), cv.astype(dt), positions)
     elif cfg.attn_impl in ("ring", "ring_flash"):
+        from ..parallel.ring import ring_attention
+
         # GQA kv heads stay grouped: the ring rotates kv-width blocks
         # (h/kvh x less ICI traffic) and widens per fold step locally
         att = ring_attention(
@@ -424,6 +458,8 @@ def _attn_residual(bp, x, positions, cfg, kv=None, segments=None):
 
         att = flash_attention(q, k, v, True)
     else:
+        from ..parallel.ring import full_attention
+
         if kvh != h:
             k = jnp.repeat(k, h // kvh, axis=2)
             v = jnp.repeat(v, h // kvh, axis=2)
@@ -564,7 +600,7 @@ def apply(
         # below the crossover the fused XLA path wins; at long L flash's
         # O(L) HBM traffic does.  Custom positions force the XLA paths
         # (the Pallas kernels mask with row-major arange).
-        mesh = jax.sharding.get_abstract_mesh()
+        mesh = _abstract_mesh()
         sp = (
             mesh.shape["sp"]
             if mesh is not None and "sp" in mesh.axis_names

@@ -202,6 +202,14 @@ _counters: Dict[str, int] = {
     "fleet_jobs_migrated": 0,
     "fleet_quarantines": 0,
     "fleet_replica_restarts": 0,
+    # round 22: paged continuous decode — tokens the decode scheduler
+    # generated (billed per tenant), KV pages the pool allocated/freed
+    # (churn vs occupancy drives the kv_fragmentation doctor rule), and
+    # bucket-coalesced prefill batches the disaggregated prefill lane ran
+    "decode_tokens": 0,
+    "kv_pages_allocated": 0,
+    "kv_pages_freed": 0,
+    "decode_prefill_batches": 0,
 }
 _by_verb: Dict[str, Dict[str, int]] = {}
 
@@ -895,6 +903,30 @@ def note_fleet_replica_restart() -> None:
     _bump("fleet_replica_restarts")
 
 
+def note_decode_tokens(n: int) -> None:
+    """``n`` tokens emitted by the paged decode scheduler (committed
+    output only — drafts a speculative verify rejected don't count)."""
+    _bump("decode_tokens", n)
+
+
+def note_kv_pages_allocated(n: int) -> None:
+    """``n`` KV pages reserved from the page pool for one sequence
+    (``models/kv_pager.py``)."""
+    _bump("kv_pages_allocated", n)
+
+
+def note_kv_pages_freed(n: int) -> None:
+    """``n`` KV pages returned to the pool at sequence retirement,
+    cancellation, or deadline expiry."""
+    _bump("kv_pages_freed", n)
+
+
+def note_decode_prefill_batch() -> None:
+    """One bucket-coalesced prefill batch run by the disaggregated
+    prefill lane of the decode scheduler."""
+    _bump("decode_prefill_batches")
+
+
 def note_stream_window() -> None:
     """One streamed window materialised into host columns by the
     windowed reader (``streaming/reader.py``)."""
@@ -1063,6 +1095,10 @@ def counters_delta(
             "fleet_jobs_migrated",
             "fleet_quarantines",
             "fleet_replica_restarts",
+            "decode_tokens",
+            "kv_pages_allocated",
+            "kv_pages_freed",
+            "decode_prefill_batches",
         )
     }
 

@@ -399,7 +399,15 @@ class _HbmBudget:
 
     def __init__(self):
         self._lock = threading.Lock()
-        # key: (id(cache), bi) -> (weakref(cache), bi, nbytes, tenant)
+        # key: (id(cache), bi) ->
+        #     (weakref(cache), bi, nbytes, tenant, pinned)
+        # ``pinned`` (round 22): the entry is accounting for memory that
+        # CANNOT be evicted-and-restored (a live decode sequence's KV
+        # pages — evicting them would corrupt in-flight generation, not
+        # just cost a re-stage).  Pinned entries are skipped by every
+        # eviction walk; when a PINNED charge cannot fit after evicting
+        # all unpinned shards, charge() returns False and the caller
+        # surfaces a typed admission refusal instead of OOMing mid-step.
         self._entries: "collections.OrderedDict" = collections.OrderedDict()
         self.total_bytes = 0
         # per-tenant resident bytes (round 19, TFS_CACHE_TENANT_BUDGET)
@@ -416,7 +424,7 @@ class _HbmBudget:
         for dead/refunded entries.  The hook runs OUTSIDE the lock —
         spill-backed eviction does disk I/O (``FrameCache.evict``), and
         a process-wide lock must never wait on a disk write."""
-        ref, bi, nbytes, tenant = self._entries.pop(key)
+        ref, bi, nbytes, tenant, _pinned = self._entries.pop(key)
         self.total_bytes -= nbytes
         if tenant is not None:
             left = self.tenant_bytes.get(tenant, 0) - nbytes
@@ -439,11 +447,24 @@ class _HbmBudget:
         for key in [k for k, v in self._entries.items() if v[0]() is None]:
             self._drop(key)
 
-    def charge(self, cache: FrameCache, bi: int, nbytes: int) -> bool:
+    def _lru_victim(self, keys) -> Optional[tuple]:
+        """Oldest UNPINNED key in ``keys`` (lock held), or None when
+        everything remaining is pinned (live KV pages are not evictable
+        — round 22)."""
+        for k in keys:
+            entry = self._entries.get(k)
+            if entry is not None and not entry[4]:
+                return k
+        return None
+
+    def charge(
+        self, cache: FrameCache, bi: int, nbytes: int, pinned: bool = False
+    ) -> bool:
         budget = hbm_budget()
         t_budget = tenant_budget()
         tenant = getattr(cache, "tenant", None)
-        evictions = []
+        evictions: list = []
+        admitted = True
         with self._lock:
             self._prune()
             key = (id(cache), bi)
@@ -453,42 +474,64 @@ class _HbmBudget:
                 # refusal, not eviction: the shard was never resident,
                 # so the eviction counter (LRU churn evidence) stays put
                 return False
+            if tenant is not None and t_budget and nbytes > t_budget:
+                return False  # one shard over the whole tenant cap
             if tenant is not None and t_budget:
-                if nbytes > t_budget:
-                    return False  # one shard over the whole tenant cap
                 # over-budget tenants evict their OWN LRU shards first
                 # (round 19): other tenants' warm shards stay resident
                 while (
-                    self.tenant_bytes.get(tenant, 0) + nbytes > t_budget
+                    admitted
+                    and self.tenant_bytes.get(tenant, 0) + nbytes > t_budget
                 ):
                     keys = self.tenant_keys.get(tenant)
-                    if not keys:
-                        break  # accounting drift: fall through to global
-                    victim = self._drop(next(iter(keys)))
+                    vkey = self._lru_victim(keys or ())
+                    if vkey is None:
+                        # the tenant's remaining residency is all pinned
+                        # pages (round 22): a further PINNED charge is a
+                        # typed per-tenant admission refusal; an
+                        # unpinned shard falls through to the global
+                        # walk (accounting drift tolerance, as before)
+                        admitted = not pinned
+                        break
+                    victim = self._drop(vkey)
                     if victim is not None:
                         evictions.append(victim)
-            if budget:
-                while self.total_bytes + nbytes > budget and self._entries:
-                    oldest = next(iter(self._entries))
-                    victim = self._drop(oldest)
+            if admitted and budget:
+                while self.total_bytes + nbytes > budget:
+                    vkey = self._lru_victim(self._entries)
+                    if vkey is None:
+                        # nothing evictable is left.  Pinned charge:
+                        # refuse instead of over-committing live decode
+                        # memory (the caller surfaces retry_after_ms).
+                        # Unpinned shard: keep the PR 5 semantics
+                        # (insert once the walk is exhausted).
+                        admitted = not pinned
+                        break
+                    victim = self._drop(vkey)
                     if victim is not None:
                         evictions.append(victim)
-            self._entries[key] = (weakref.ref(cache), bi, nbytes, tenant)
-            self.total_bytes += nbytes
-            if tenant is not None:
-                self.tenant_bytes[tenant] = (
-                    self.tenant_bytes.get(tenant, 0) + nbytes
+            if admitted:
+                self._entries[key] = (
+                    weakref.ref(cache), bi, nbytes, tenant, pinned
                 )
-                self.tenant_keys.setdefault(
-                    tenant, collections.OrderedDict()
-                )[key] = None
+                self.total_bytes += nbytes
+                if tenant is not None:
+                    self.tenant_bytes[tenant] = (
+                        self.tenant_bytes.get(tenant, 0) + nbytes
+                    )
+                    self.tenant_keys.setdefault(
+                        tenant, collections.OrderedDict()
+                    )[key] = None
         # eviction hooks after the lock is released: a reader that races
         # in between sees either the still-resident shard (fine: shards
-        # are immutable) or the evicted/spilled state
+        # are immutable) or the evicted/spilled state.  Hooks run on the
+        # refusal path too — their entries were already unaccounted, so
+        # skipping them would leave resident shards the budget no longer
+        # tracks.
         for victim, vbi in evictions:
             victim.evict(vbi)
             observability.note_cache_eviction()
-        return True
+        return admitted
 
     def touch(self, cache: FrameCache, bi: int) -> None:
         with self._lock:

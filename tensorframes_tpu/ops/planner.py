@@ -1464,6 +1464,15 @@ def _plan_signature(
     )
 
 
+class _ReduceResult(dict):
+    """A reduce-terminal CSE result: plain dicts cannot carry weak
+    references, and the registry holds completed results by weakref
+    only (so cached outputs never outlive their consumers).  Behaves
+    exactly like the ``{base: ndarray}`` dict it wraps."""
+
+    __slots__ = ("__weakref__",)
+
+
 class _CseEntry:
     __slots__ = (
         "event",
@@ -2013,13 +2022,124 @@ class LazyFrame:
     def _reduce(self, verb: str, program: Program, mode: str = "tree"):
         self._bump("_children")
         if self._materialized is None:
-            out = self._fused_terminal_reduce(verb, program, mode)
+            out = self._cse_reduce(verb, program, mode)
             if out is not None:
                 return out
         mat = self._materialize(needed_hint=_reduce_cols(program))
         if verb == "reduce_rows":
             return _DEFAULT.reduce_rows(program, mat, mode=mode)
         return _DEFAULT.reduce_blocks(program, mat)
+
+    def _cse_reduce(self, verb: str, program: Program, mode):
+        """Route the fused terminal reduce through the CSE registry
+        (round-22 close of the round-19 residual): concurrent requests
+        ending in the SAME fused reduce over the SAME chain rendezvous
+        and execute once, with the owner's private-ledger delta
+        apportioned exactly across every consumer — the same share
+        semantics map-terminal plans already have.  The signature is
+        the chain's plan signature extended with the reduce's identity
+        (verb, mode, program), and the entry additionally guards on the
+        reduce program's lifetime.  Falls back to a solo
+        ``_fused_terminal_reduce`` whenever the signature cannot be
+        built (host stages, CSE off); a ``None`` from the fused path
+        (pre-dispatch bail: serial decision, trimmed chain, source
+        column read) fails the entry so waiters pay their own way, and
+        the caller falls through to materialize-then-reduce."""
+        if not cse_enabled():
+            return self._fused_terminal_reduce(verb, program, mode)
+        tc = self._terminal_chain()
+        if tc is None:
+            # cheap pre-check: no fusable chain means the fused path
+            # bails immediately anyway — don't mint registry entries
+            # for plans that always materialize
+            return self._fused_terminal_reduce(verb, program, mode)
+        _entry, chain, _steps, frame = tc
+        base_sig = _plan_signature(chain, frame, None)
+        if base_sig is None:
+            return self._fused_terminal_reduce(verb, program, mode)
+        sig = base_sig + (
+            (
+                "reduce",
+                verb,
+                mode,
+                id(program),
+                getattr(program, "_params_version", 0),
+            ),
+        )
+        claim = _REGISTRY.lookup_or_claim(sig, frame, chain)
+        label = (
+            "+".join(nd._step.label for nd in chain) + f"+{verb}"
+        )
+        if claim[0] == "hit":
+            observability.note_plan_cse_hit()
+            self._last_records = [
+                {
+                    "stage": 0,
+                    "verb": label,
+                    "fused": len(chain) + 1,
+                    "dispatch": "cse",
+                    "reason": "registry_hit",
+                    "terminal": verb,
+                }
+            ]
+            return claim[1]
+        if claim[0] == "wait":
+            _, slot, event = claim
+            try:
+                while not event.wait(0.05):
+                    cancellation.checkpoint()
+            except BaseException:
+                with _REGISTRY._lock:
+                    if slot.get("frame") is None:
+                        slot["abandoned"] = True
+                raise
+            out = slot.get("frame")
+            if out is None:
+                with _REGISTRY._lock:
+                    if slot.get("frame") is None:
+                        slot["abandoned"] = True
+                out = slot.get("frame")
+            if out is not None:
+                observability.note_plan_cse_hit()
+                self._last_records = [
+                    {
+                        "stage": 0,
+                        "verb": label,
+                        "fused": len(chain) + 1,
+                        "dispatch": "cse",
+                        "reason": "shared_inflight",
+                        "terminal": verb,
+                    }
+                ]
+                return out
+            # owner failed or bailed to the materialized path: run our
+            # own fused attempt (it may bail to materialize too)
+            return self._fused_terminal_reduce(verb, program, mode)
+        ent = claim[1]
+        # the chain guards came from lookup_or_claim; the reduce
+        # program's lifetime guards this entry too (its id is in the
+        # signature — an id reused by a NEW program must not hit)
+        ent.guards.append(weakref.ref(program))
+        tok0 = observability.activate_request(None)
+        led = observability.RequestLedger(method="plan_cse")
+        tok1 = observability.activate_request(led)
+        try:
+            out = self._fused_terminal_reduce(verb, program, mode)
+        except BaseException:
+            observability.deactivate_request(tok1)
+            observability.deactivate_request(tok0)
+            _REGISTRY.fail(sig, ent)
+            raise
+        observability.deactivate_request(tok1)
+        observability.deactivate_request(tok0)
+        if out is None:
+            # pre-dispatch bail: nothing executed, nothing to share —
+            # waiters wake, fall back, and pay their own (cheap) way
+            _REGISTRY.fail(sig, ent)
+            return None
+        out = _ReduceResult(out)
+        _REGISTRY.complete(sig, ent, out, led)
+        return out
 
     def _terminal_chain(self):
         """The unmaterialised step chain back to the nearest memo/root,

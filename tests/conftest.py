@@ -175,6 +175,17 @@ os.environ.setdefault("TFS_FLEET_QUARANTINE_S", "")      # hold: default
 # busy-retry hint cap (round 21): default cap, jitter unaffected
 os.environ.setdefault("TFS_BRIDGE_CLIENT_BUSY_CAP_MS", "")
 
+# Paged continuous decode (round 22, models/kv_pager.py + the bridge
+# DecodeScheduler): page size and slot count at their documented
+# defaults (16 tokens/page, 8 slots) in the main suite — the paged
+# tests size pools/pages explicitly via constructor args so the
+# bit-identity and refusal contracts are deterministic regardless of a
+# developer's exported knobs.  run_tests.sh's decode tier re-runs them
+# with the knobs live in a forced-8-device child.  Absence-defaults
+# (setdefault) like every TFS_* pin above.
+os.environ.setdefault("TFS_DECODE_PAGE_TOKENS", "")
+os.environ.setdefault("TFS_DECODE_MAX_SLOTS", "")
+
 # Absence-default pins for every remaining TFS_* knob the package reads
 # (round 17; enforced by tools/tfs_lint.py rule `knob-pins`).  Each pin
 # is the knob's documented "unset" behavior — setdefault, so an

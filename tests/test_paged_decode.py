@@ -1,0 +1,514 @@
+"""Paged KV-cache continuous decode (round 22).
+
+The contract under test, at every layer:
+
+* **bit-identity** — the paged, gathered attention path produces
+  token streams bit-identical to the contiguous ``decode.generate``
+  pinned at the scheduler's capacity (``cache_len=cap``): the gathered
+  extent equals the contiguous cache's, masked slots carry exact-zero
+  softmax weight, and rows under the batched einsums are independent,
+  so neither paging, batching with strangers, nor joining mid-run may
+  change a single token.
+* **refusal, not OOM** — the full page span is reserved at admission;
+  exhaustion surfaces as a typed :class:`DecodeRefused` (mapped to
+  ``server_busy`` on the wire) with ``retry_after_ms``, never as a
+  mid-step failure.
+* **retirement frees** — deadline expiry, cancellation, and normal
+  completion all release pages at a step boundary; neighbors are
+  unaffected (their outputs stay bit-identical to an uninterrupted
+  run), including under injected transient dispatch faults.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorframes_tpu import cancellation
+from tensorframes_tpu import observability as obs
+from tensorframes_tpu.bridge.client import BridgeClient, ServerBusy
+from tensorframes_tpu.bridge.coalescer import DecodeRefused, DecodeScheduler
+from tensorframes_tpu.bridge.server import serve
+from tensorframes_tpu.models import decode, kv_pager
+from tensorframes_tpu.models import transformer as tfm
+
+CFG = tfm.TransformerConfig(
+    vocab_size=97,
+    d_model=32,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,  # GQA: pages store kvh < h heads
+    d_ff=64,
+    max_seq=64,
+    dtype=jnp.float32,
+)
+PAGE = 8
+CAP = 64
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init(jax.random.PRNGKey(0), CFG)
+
+
+def _reference(params, prompt, max_new, cap=CAP):
+    """The contiguous-cache greedy continuation at the paged capacity."""
+    out = decode.generate(
+        params,
+        jnp.asarray(np.asarray(prompt, np.int32)[None]),
+        CFG,
+        max_new,
+        cache_len=cap,
+    )
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+
+def _prompts(spec, seed=1):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.integers(0, CFG.vocab_size, size=(L,)).astype(np.int32), mn)
+        for L, mn in spec
+    ]
+
+
+# ---------------------------------------------------------------------------
+# pager layer: gather-based attention over pages
+# ---------------------------------------------------------------------------
+
+
+def test_paged_attention_bit_identical_to_contiguous(params):
+    """Disaggregated prefill + batched decode over pages, mixed prompt
+    lengths sharing one pool, equals per-sequence contiguous generate
+    token for token."""
+    cp = decode.cast_params(params, CFG.dtype)
+    max_pages = CAP // PAGE
+    jobs = _prompts(((5, 6), (11, 6), (7, 6)), seed=0)
+    B = len(jobs)
+    refs = [_reference(params, p, mn) for p, mn in jobs]
+
+    pool = kv_pager.PagePool(CFG, n_pages=max_pages * B + 1, tokens_per_page=PAGE)
+    kp, vp = pool.k_pages, pool.v_pages
+    tables = kv_pager.init_tables(B, max_pages)
+    for b, (p, mn) in enumerate(jobs):
+        _, pages = pool.allocate(
+            kv_pager.pages_for(p.size + mn, PAGE), tenant=f"t{b}"
+        )
+        for s, pg in enumerate(pages):
+            tables = tables.at[b, s].set(pg)
+
+    # prefill lane: each sequence in its own batch (only its pages are
+    # written; other rows' tables are absent from the batch entirely)
+    outs = [[] for _ in range(B)]
+    last = [0] * B
+    for b, (p, _) in enumerate(jobs):
+        logits, kp, vp = kv_pager.apply_paged(
+            cp, jnp.asarray(p[None]), tables[b : b + 1],
+            jnp.zeros((1,), jnp.int32), kp, vp, CFG,
+        )
+        last[b] = int(jnp.argmax(logits[0, -1]))
+        outs[b].append(last[b])
+
+    # decode lane: one fixed-shape batched step, per-row frontiers
+    indices = jnp.asarray([p.size for p, _ in jobs], jnp.int32)
+    toks = jnp.asarray(last, jnp.int32)
+    for _ in range(jobs[0][1] - 1):
+        toks, kp, vp = kv_pager.paged_decode_step(
+            cp, toks, tables, indices, kp, vp, CFG
+        )
+        indices = indices + 1
+        for b in range(B):
+            outs[b].append(int(toks[b]))
+
+    for b in range(B):
+        assert outs[b] == refs[b], f"row {b} diverged from contiguous"
+
+
+def test_page_pool_exhaustion_is_typed_and_free_restores():
+    pool = kv_pager.PagePool(CFG, n_pages=4, tokens_per_page=PAGE)
+    assert pool.stats()["pages_free"] == 3  # page 0 is the trash page
+    charge, pages = pool.allocate(3, tenant="a")
+    assert len(pages) == 3 and 0 not in pages
+    with pytest.raises(kv_pager.PagesExhausted) as ei:
+        pool.allocate(2, tenant="b")
+    assert ei.value.reason == "pool"
+    assert ei.value.retry_after_ms > 0
+    assert ei.value.needed == 2 and ei.value.free == 0
+    pool.free(charge)
+    assert pool.stats()["pages_free"] == 3
+    charge2, _ = pool.allocate(3, tenant="b")  # freed pages are reusable
+    pool.free(charge2)
+
+
+# ---------------------------------------------------------------------------
+# scheduler layer: continuous batching over page tables
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_concurrent_mixed_streams_bit_identical(params):
+    """Six concurrent mixed short/long streams over four slots: every
+    stream's tokens equal its solo contiguous run; late arrivals join
+    at step boundaries; retirement returns every page."""
+    jobs = _prompts(((5, 6), (11, 3), (7, 10), (3, 4), (9, 2), (13, 7)))
+    sched = DecodeScheduler(
+        params, CFG, max_slots=4, tokens_per_page=PAGE, max_seq=CAP
+    )
+    try:
+        refs = [_reference(params, p, mn, cap=sched.cap) for p, mn in jobs]
+        results = [None] * len(jobs)
+        errs = []
+
+        def worker(i):
+            try:
+                p, mn = jobs[i]
+                results[i] = sched.submit(
+                    p, mn, tenant=f"t{i % 2}", timeout_s=120
+                )
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        c0 = obs.counters()
+        ts = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(len(jobs))
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+        d = obs.counters_delta(c0)
+        for i in range(len(jobs)):
+            assert results[i] == refs[i], f"stream {i} diverged"
+        snap = sched.snapshot()
+        assert snap["retired"] == len(jobs)
+        assert snap["pages_used"] == 0, "pages leaked past retirement"
+        assert snap["prefill_batches"] >= 1
+        # six streams over four slots: someone joined a running batch
+        assert snap["joined_mid_run"] >= 1
+        assert d["decode_tokens"] == sum(mn for _, mn in jobs)
+        assert d["kv_pages_allocated"] == d["kv_pages_freed"] > 0
+        assert d["decode_prefill_batches"] == snap["prefill_batches"]
+    finally:
+        sched.close()
+
+
+def test_scheduler_admission_refusals_are_typed(params):
+    # page-pool refusal: the span cannot be reserved
+    small = DecodeScheduler(
+        params, CFG, max_slots=2, tokens_per_page=PAGE,
+        max_seq=CAP, pool_pages=3,
+    )
+    try:
+        with pytest.raises(DecodeRefused) as ei:
+            small.submit(np.arange(5, dtype=np.int32), 30, timeout_s=10)
+        assert ei.value.reason == "pages"
+        assert ei.value.retry_after_ms > 0
+        assert small.snapshot()["refused_pages"] == 1
+        # nothing was admitted, so the refusal happened while slots idled
+        assert small.snapshot()["refused_while_idle"] == 1
+    finally:
+        small.close()
+
+    # backlog refusal: active + pending at twice the slot count
+    one = DecodeScheduler(
+        params, CFG, max_slots=1, tokens_per_page=PAGE, max_seq=CAP,
+        pool_pages=16,
+    )
+    try:
+        jobs = _prompts(((6, 12), (6, 12)), seed=3)
+        ts = [
+            threading.Thread(
+                target=lambda p=p, mn=mn: one.submit(p, mn, timeout_s=120)
+            )
+            for p, mn in jobs
+        ]
+        for t in ts:
+            t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            s = one.snapshot()
+            if s["active"] + s["pending"] >= 2:
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("streams never occupied the backlog")
+        with pytest.raises(DecodeRefused) as ei:
+            one.submit(np.arange(4, dtype=np.int32), 4, timeout_s=10)
+        assert ei.value.reason == "slots"
+        assert ei.value.retry_after_ms > 0
+        for t in ts:
+            t.join()
+    finally:
+        one.close()
+
+
+def test_scheduler_deadline_expiry_frees_pages_neighbors_bit_identical(
+    params,
+):
+    """An expired deadline cancels at a step boundary: the victim's
+    submit raises ``DeadlineExceeded``, its pages return to the pool,
+    and the neighbors' streams are bit-identical to an uninterrupted
+    run."""
+    neighbors = _prompts(((5, 8), (9, 8)), seed=4)
+    sched = DecodeScheduler(
+        params, CFG, max_slots=4, tokens_per_page=PAGE, max_seq=CAP
+    )
+    try:
+        refs = [_reference(params, p, mn, cap=sched.cap) for p, mn in neighbors]
+        results = [None] * len(neighbors)
+        victim_err = []
+        errs = []
+
+        def neighbor(i):
+            try:
+                p, mn = neighbors[i]
+                results[i] = sched.submit(p, mn, timeout_s=120)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        def victim():
+            scope = cancellation.CancelScope(deadline_s=0.0, label="victim")
+            try:
+                with cancellation.activate(scope):
+                    sched.submit(
+                        np.arange(7, dtype=np.int32), 12, timeout_s=120
+                    )
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                victim_err.append(e)
+
+        c0 = obs.counters()
+        ts = [threading.Thread(target=neighbor, args=(i,)) for i in (0, 1)]
+        ts.append(threading.Thread(target=victim))
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+        d = obs.counters_delta(c0)
+        assert len(victim_err) == 1
+        assert isinstance(victim_err[0], cancellation.Cancelled)
+        for i in range(len(neighbors)):
+            assert results[i] == refs[i], f"neighbor {i} diverged"
+        snap = sched.snapshot()
+        assert snap["pages_used"] == 0, "cancelled stream leaked pages"
+        assert d["kv_pages_allocated"] == d["kv_pages_freed"] > 0
+        assert d["bridge_deadline_exceeded"] >= 1
+    finally:
+        sched.close()
+
+
+def test_scheduler_drain_mid_stream_completes_in_flight(params):
+    """close() mid-stream drains: already-submitted streams run to
+    retirement bit-identically; later submits are refused outright."""
+    jobs = _prompts(((5, 10), (8, 10), (11, 10)), seed=6)
+    sched = DecodeScheduler(
+        params, CFG, max_slots=4, tokens_per_page=PAGE, max_seq=CAP
+    )
+    refs = [_reference(params, p, mn, cap=sched.cap) for p, mn in jobs]
+    results = [None] * len(jobs)
+    errs = []
+
+    def worker(i):
+        try:
+            p, mn = jobs[i]
+            results[i] = sched.submit(p, mn, timeout_s=120)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ts = [
+        threading.Thread(target=worker, args=(i,)) for i in range(len(jobs))
+    ]
+    for t in ts:
+        t.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if sched.snapshot()["active"] >= 1:
+            break
+        time.sleep(0.005)
+    else:
+        pytest.fail("no stream ever became active")
+    sched.close()  # mid-stream: the batch is live right now
+    for t in ts:
+        t.join()
+    if errs:
+        raise errs[0]
+    for i in range(len(jobs)):
+        assert results[i] == refs[i], f"stream {i} diverged across drain"
+    assert sched.snapshot()["pages_used"] == 0
+    with pytest.raises(RuntimeError):
+        sched.submit(np.arange(4, dtype=np.int32), 2, timeout_s=5)
+
+
+def test_scheduler_chaos_transients_bit_identical(params, monkeypatch):
+    """Chaos leg: injected transient dispatch faults at step boundaries
+    are retried (functional page state makes the retry recompute the
+    identical step) — streams stay bit-identical and no page leaks."""
+    monkeypatch.setenv(
+        "TFS_FAULT_INJECT",
+        "transient:block=1:attempt=0;transient:block=2:attempt=0",
+    )
+    jobs = _prompts(((5, 6), (9, 5), (7, 4)), seed=7)
+    sched = DecodeScheduler(
+        params, CFG, max_slots=4, tokens_per_page=PAGE, max_seq=CAP
+    )
+    try:
+        refs = [_reference(params, p, mn, cap=sched.cap) for p, mn in jobs]
+        results = [None] * len(jobs)
+        errs = []
+
+        def worker(i):
+            try:
+                p, mn = jobs[i]
+                results[i] = sched.submit(p, mn, timeout_s=120)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        c0 = obs.counters()
+        ts = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(len(jobs))
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0]
+        d = obs.counters_delta(c0)
+        assert d["faults_injected"] >= 1, "chaos plan never fired"
+        for i in range(len(jobs)):
+            assert results[i] == refs[i], f"stream {i} diverged under chaos"
+        assert sched.snapshot()["pages_used"] == 0
+    finally:
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# serving layer: the gated decode RPC
+# ---------------------------------------------------------------------------
+
+
+def test_decode_rpc_end_to_end(params):
+    """BridgeClient.decode → scheduler → bit-identical tokens, with
+    speculative opt-in, per-tenant token billing, health and metrics
+    surfacing the round-22 families."""
+    dcfg = tfm.TransformerConfig(
+        vocab_size=CFG.vocab_size, d_model=16, n_layers=1, n_heads=2,
+        n_kv_heads=2, d_ff=32, max_seq=CAP, dtype=jnp.float32,
+    )
+    dparams = tfm.init(jax.random.PRNGKey(1), dcfg)
+    srv = serve(
+        port=0,
+        decode_model=dict(
+            params=params, cfg=CFG, draft_params=dparams, draft_cfg=dcfg,
+            max_slots=4, tokens_per_page=PAGE, max_seq=CAP,
+        ),
+    )
+    host, port = srv.server_address
+    client = BridgeClient(host=host, port=port, tenant="acme")
+    try:
+        prompt = [int(t) for t in _prompts(((7, 5),), seed=8)[0][0]]
+        ref = _reference(params, prompt, 5, cap=srv.decode_scheduler.cap)
+
+        r = client.decode(prompt, max_new=5)
+        assert r["tokens"] == ref
+        assert r["generated"] == 5 and r["speculative"] is False
+
+        rs = client.decode(prompt, max_new=5, speculative=True)
+        assert rs["speculative"] is True
+        assert rs["tokens"] == ref, "draft/verify diverged from greedy"
+
+        h = client.call("health")
+        dsnap = h["decode"]
+        assert dsnap["retired"] >= 1 and dsnap["pages_used"] == 0
+        for key in (
+            "decode_tokens",
+            "kv_pages_allocated",
+            "kv_pages_freed",
+            "decode_prefill_batches",
+        ):
+            assert key in h["counters"], key
+        assert h["counters"]["decode_tokens"] >= 10
+
+        text = client.call("metrics")["text"]
+        for family in (
+            "tfs_decode_tokens_total",
+            "tfs_kv_pages_allocated_total",
+            "tfs_kv_pages_freed_total",
+            "tfs_decode_prefill_batches_total",
+            "tfs_kv_pages_free",
+            "tfs_kv_pages_capacity",
+            "tfs_decode_slots_free",
+        ):
+            assert family in text, family
+        # decode bills generated tokens per tenant
+        assert 'tfs_request_rows_total{tenant="acme"' in text
+    finally:
+        client.close()
+        srv.close(drain_s=2.0)
+
+
+def test_decode_rpc_exhaustion_maps_to_server_busy(params):
+    srv = serve(
+        port=0,
+        decode_model=dict(
+            params=params, cfg=CFG, max_slots=2,
+            tokens_per_page=PAGE, max_seq=CAP, pool_pages=3,
+        ),
+    )
+    host, port = srv.server_address
+    client = BridgeClient(host=host, port=port, busy_retries=0)
+    try:
+        with pytest.raises(ServerBusy) as ei:
+            client.decode(list(range(5)), max_new=30)
+        assert ei.value.retry_after_ms > 0
+    finally:
+        client.close()
+        srv.close(drain_s=1.0)
+
+
+def test_decode_rpc_unconfigured_is_refused(params):
+    srv = serve(port=0)
+    host, port = srv.server_address
+    client = BridgeClient(host=host, port=port)
+    try:
+        with pytest.raises(Exception) as ei:
+            client.decode([1, 2, 3], max_new=2)
+        assert "decode" in str(ei.value).lower()
+    finally:
+        client.close()
+        srv.close(drain_s=1.0)
+
+
+def test_decode_env_knob_routing(params):
+    """A scheduler built WITHOUT explicit knobs takes its page size and
+    slot count from TFS_DECODE_PAGE_TOKENS / TFS_DECODE_MAX_SLOTS (the
+    main suite pins both inert -> defaults 16/8; run_tests.sh's decode
+    tier re-runs this file with the knobs LIVE to prove the routing)."""
+    import os
+
+    raw_p = (os.environ.get("TFS_DECODE_PAGE_TOKENS") or "").strip()
+    raw_s = (os.environ.get("TFS_DECODE_MAX_SLOTS") or "").strip()
+    exp_p = int(raw_p) if raw_p else 16
+    exp_s = int(raw_s) if raw_s else 8
+    assert kv_pager.page_tokens() == exp_p
+    sched = DecodeScheduler(params, CFG)
+    try:
+        assert sched.pool.tokens_per_page == exp_p
+        assert sched.max_slots == exp_s
+        # env-sized schedulers keep the bit-identity contract too: the
+        # gathered extent is still whole pages covering cfg.max_seq
+        assert sched.cap == kv_pager.pages_for(CFG.max_seq, exp_p) * exp_p
+        prompt = np.arange(5, dtype=np.int32) % CFG.vocab_size
+        got = sched.submit(prompt, 4, timeout_s=120)
+        ref = decode.generate(
+            params, jnp.asarray(prompt[None]), CFG, 4, cache_len=sched.cap
+        )
+        assert got == [int(t) for t in np.asarray(ref)[0, prompt.size :]]
+    finally:
+        sched.close()

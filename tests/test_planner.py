@@ -408,6 +408,11 @@ def test_pooled_planner_h2d_drop_and_decision(monkeypatch):
     # POOLS (host-assembled outputs -> the auto-cache story under test);
     # the serial decision has its own test below
     monkeypatch.setenv("TFS_PLAN_POOL_MIN_INTENSITY", "0")
+    # the second reduce must EXECUTE (that second consumption is the
+    # auto-cache trigger under test): without this the round-22
+    # reduce-terminal CSE registry serves it as a hit — one dispatch,
+    # no cache insert (its own fences live in test_planner_v2.py)
+    monkeypatch.setenv("TFS_PLAN_CSE", "0")
     n, nb, d = 256, 8, 8
     rng = np.random.RandomState(0)
     data = {
@@ -501,6 +506,10 @@ def test_pooled_planner_autocache_weakref_refunds_budget(monkeypatch):
     monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
     monkeypatch.setenv("TFS_PLAN_POOL_MIN_INTENSITY", "0")
     monkeypatch.setenv("TFS_HBM_BUDGET", "64M")
+    # the second reduce must EXECUTE to trigger the auto-cache whose
+    # refund is under test — pin the round-22 reduce-terminal CSE off
+    # (a registry hit would skip the second consumption entirely)
+    monkeypatch.setenv("TFS_PLAN_CSE", "0")
     # settle cyclic garbage first: an earlier test's source-frame <->
     # plan-root cycle (frame._tfs_lazy_root) releases its entry cache
     # only at cyclic GC, which would otherwise land inside this test's

@@ -362,6 +362,98 @@ def test_cse_concurrent_requests_share_and_ledgers_sum_exactly():
         )
 
 
+def test_reduce_terminal_cse_concurrent_requests_execute_once(monkeypatch):
+    """Round 22, the round-19 residual closed: two concurrent requests
+    ending in the SAME fused terminal reduce rendezvous through the CSE
+    registry — ONE fused execution, exact absorbed ledger shares, like
+    map-terminal plans."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_PLAN_POOL_MIN_INTENSITY", "0")
+    m1, m2 = _chain_programs()
+    red = tfs.Program.wrap(
+        lambda z_input: {"z": (z_input * 1.3).sum(0)}, fetches=["z"]
+    )
+    # warm the fused executable on a throwaway frame so the concurrent
+    # race below measures the rendezvous, not first-compile skew
+    warm_frame = _frame(n=192, nb=4, seed=9)
+    tfs.reduce_blocks(
+        red, tfs.map_blocks(m2, tfs.map_blocks(m1, warm_frame.lazy()))
+    )
+
+    frame = _frame(n=192, nb=4, seed=10)
+    b = tfs.map_blocks(m2, tfs.map_blocks(m1, frame, engine=_EAGER),
+                       engine=_EAGER)
+    ref = tfs.reduce_blocks(red, b, engine=_EAGER)["z"]
+
+    snaps = [None, None]
+    zs = [None, None]
+    barrier = threading.Barrier(2)
+    errs = []
+
+    def worker(i):
+        try:
+            with obs.request_ledger(tenant=f"t{i}", method="verb") as led:
+                barrier.wait()
+                lz = tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy()))
+                zs[i] = tfs.reduce_blocks(red, lz)["z"]
+            snaps[i] = led.snapshot()
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    c0 = obs.counters()
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise errs[0]
+    d = obs.counters_delta(c0)
+    np.testing.assert_array_equal(np.asarray(zs[0]), np.asarray(zs[1]))
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(zs[0]))
+    assert d["plan_cse_hits"] == 1, d
+    assert d["plan_fused_reduces"] == 1, d  # the fold ran ONCE
+    sums = {}
+    for s in snaps:
+        for k, v in s["counters"].items():
+            sums[k] = sums.get(k, 0) + v
+    for k, v in d.items():
+        if k == "plan_cse_hits":
+            continue  # the hit is noted by the consumer outside absorb
+        assert sums.get(k, 0) == v, (
+            f"ledger shares sum {sums.get(k, 0)} != global delta {v} "
+            f"for {k}"
+        )
+
+
+def test_reduce_terminal_cse_registry_hit_when_result_held(monkeypatch):
+    """A later identical reduce whose earlier result is still alive is
+    served from the registry: same object back, zero traces, zero
+    staging — and the reuse is visible in the plan records."""
+    monkeypatch.setenv("TFS_DEVICE_POOL", "auto")
+    monkeypatch.setenv("TFS_PLAN_POOL_MIN_INTENSITY", "0")
+    frame = _frame(n=96, nb=4, seed=11)
+    m1, m2 = _chain_programs()
+    red = tfs.Program.wrap(
+        lambda z_input: {"z": (z_input * 1.3).sum(0)}, fetches=["z"]
+    )
+    lz1 = tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy()))
+    r1 = tfs.reduce_blocks(red, lz1)  # HOLD the result dict
+    c0 = obs.counters()
+    lz2 = tfs.map_blocks(m2, tfs.map_blocks(m1, frame.lazy()))
+    r2 = tfs.reduce_blocks(red, lz2)
+    d = obs.counters_delta(c0)
+    assert r2 is r1, "registry hit must return the cached result"
+    np.testing.assert_array_equal(np.asarray(r1["z"]), np.asarray(r2["z"]))
+    assert d["plan_cse_hits"] == 1, d
+    assert d["program_traces"] == 0, d
+    assert d["h2d_bytes_staged"] == 0, d
+    assert any(
+        r.get("dispatch") == "cse" and r.get("terminal") == "reduce_blocks"
+        for r in lz2._last_records
+    ), lz2._last_records
+
+
 def test_bridge_concurrent_requests_cse_execute_once(monkeypatch):
     """Acceptance (b), real bridge path: two concurrent verb RPCs on
     the SAME registered frame with the warm-pool-shared program execute
